@@ -1,0 +1,16 @@
+"""byol_tpu — a TPU-native (JAX/XLA/Pallas/pjit) self-supervised learning
+framework with the capabilities of jramapuram/BYOL (arXiv 2006.07733).
+
+Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
+  core/          config, rng, dtype policy            (replaces C1, args global)
+  parallel/      mesh, collectives, ring attention    (replaces NCCL/DDP, C12, C14)
+  models/        ResNet/ViT backbones, heads, BN      (replaces C3 model body)
+  objectives/    BYOL loss, probe loss, metrics       (replaces C4, helpers.metrics)
+  optim/         LARS, schedules, registry            (replaces C5-C7)
+  byol/          train state, EMA target, train step  (replaces C2, C11)
+  data/          two-view pipelines, device augs      (replaces datasets submodule, C8, DALI)
+  checkpoint/    orbax save/restore, early stop       (replaces ModelSaver)
+  observability/ metric writers, profiler             (replaces Grapher)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,217 @@
+"""Immutable, typed configuration for byol_tpu.
+
+Replaces the reference's module-global mutable ``args`` (see
+/root/reference/main.py:35-119, mutated at main.py:119,128-130,420-425,725,
+727-729,787).  Flag names mirror the reference CLI surface (SURVEY.md App B)
+so users of the reference find the same knobs; derived quantities
+(steps_per_epoch with drop-remainder, total_train_steps, per-replica sample
+counts — reference main.py:420-425) are computed exactly once by
+``resolve()`` and frozen.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Optional, Tuple
+
+
+def _frozen(cls):
+    return dataclasses.dataclass(frozen=True)(cls)
+
+
+@_frozen
+class TaskConfig:
+    """Task / dataset group (reference main.py:37-53)."""
+
+    task: str = "image_folder"          # ref default 'multi_augment_image_folder'
+    data_dir: str = "./data"
+    batch_size: int = 4096              # GLOBAL batch (ref main.py:41-42)
+    epochs: int = 3000
+    download: bool = False
+    image_size_override: Optional[int] = 224  # ref main.py:46-47
+    log_dir: str = "./runs"
+    uid: str = ""                       # run identity (ref main.py:52-53)
+
+
+@_frozen
+class ModelConfig:
+    """Model group (reference main.py:56-70)."""
+
+    arch: str = "resnet50"
+    representation_size: int = 2048     # must match arch in the ref (Quirk Q8);
+                                        # here it is DERIVED from the registry
+                                        # unless explicitly overridden.
+    projection_size: int = 256          # ref main.py:61-62
+    head_latent_size: int = 4096        # ref main.py:63-64 (projector hidden)
+    base_decay: float = 0.996           # EMA tau_0 (ref main.py:65-66)
+    weight_initialization: Optional[str] = None  # ref main.py:67-68
+    model_dir: str = ".models"
+    # TPU-native additions (no reference analog):
+    fuse_views: bool = False            # concat the two views into one encoder
+                                        # call (2 fwds instead of 4). Changes BN
+                                        # batch statistics vs the reference's
+                                        # per-view forwards (main.py:244-247),
+                                        # so off by default; turn on for perf.
+    remat: bool = False                 # jax.checkpoint the encoder to trade
+                                        # FLOPs for HBM.
+
+
+@_frozen
+class RegularizerConfig:
+    """Regularizer group (reference main.py:72-78)."""
+
+    color_jitter_strength: float = 1.0
+    weight_decay: float = 1e-6
+    polyak_ema: float = 0.0
+    convert_to_sync_bn: bool = True     # under GSPMD jit, BN is cross-replica
+                                        # by construction; False forces
+                                        # per-device stats via shard_map.
+
+
+@_frozen
+class OptimConfig:
+    """Optimization group (reference main.py:80-91)."""
+
+    clip: float = 0.0                   # grad VALUE clip (ref main.py:619-622)
+    lr: float = 0.2                     # base LR before linear scaling
+    lr_update_schedule: str = "cosine"  # fixed | cosine (ref main.py:85-86)
+    warmup: int = 10                    # warmup epochs (ref main.py:87)
+    optimizer: str = "lars_momentum"    # registry key; 'lars_' prefix composes
+    early_stop: bool = False
+
+
+@_frozen
+class DeviceConfig:
+    """Device / debug / distributed group (reference main.py:99-117)."""
+
+    num_replicas: int = 8               # data-parallel size (mesh 'data' axis)
+    workers_per_replica: int = 2
+    distributed_master: str = ""        # JAX coordinator address analog
+    distributed_rank: int = 0           # process_index analog
+    distributed_port: int = 29300
+    debug_step: bool = False            # single-minibatch smoke (ref main.py:110)
+    seed: int = 1234
+    half: bool = True                   # bf16 compute policy (apex-O2 analog,
+                                        # ref main.py:122-124; no loss scaling
+                                        # needed on TPU bf16)
+    # TPU-native mesh shape: data x model x sequence. model/sequence default 1.
+    model_parallel: int = 1
+    sequence_parallel: int = 1
+
+
+@_frozen
+class ParityConfig:
+    """Faithfulness switches for reference quirks (SURVEY.md App A)."""
+
+    loss_norm_mode: str = "paper"       # 'paper' per-row l2 | 'reference'
+                                        # whole-tensor Frobenius (objective.py:8-9)
+    ema_init_mode: str = "copy"         # 'copy' (paper) | 'reference'
+                                        # (Quirk Q1: mean starts at 0.004*theta)
+    schedule_granularity: str = "step"  # 'step' | 'epoch' (Quirk Q5)
+    normalize_inputs: bool = False      # ref never normalizes (Quirk Q3)
+    ema_update_mode: str = "post"       # 'post' (paper: EMA of post-update
+                                        # params) | 'reference_pre' (ref EMAs
+                                        # pre-update params inside forward,
+                                        # main.py:255)
+    zero_init_residual: bool = True     # zero-init last BN scale per block
+                                        # (large-batch trick); False matches
+                                        # torchvision/reference init
+                                        # (main.py:436, default init)
+
+
+@_frozen
+class Config:
+    task: TaskConfig = TaskConfig()
+    model: ModelConfig = ModelConfig()
+    regularizer: RegularizerConfig = RegularizerConfig()
+    optim: OptimConfig = OptimConfig()
+    device: DeviceConfig = DeviceConfig()
+    parity: ParityConfig = ParityConfig()
+
+    def replace(self, **sections) -> "Config":
+        return dataclasses.replace(self, **sections)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+
+@_frozen
+class ResolvedConfig:
+    """Config + derived quantities, computed once (vs reference smuggling them
+    through the mutable global ``args`` at main.py:420-425,725)."""
+
+    cfg: Config
+    input_shape: Tuple[int, int, int]       # (H, W, C) — NHWC, TPU-native layout
+    num_train_samples: int                  # per-replica (ref main.py:421)
+    num_test_samples: int                   # NOT sharded in ref (main.py:422)
+    output_size: int                        # number of classes
+    steps_per_train_epoch: int              # drop-remainder (ref main.py:424)
+    total_train_steps: int                  # ref main.py:425
+    batch_size_per_replica: int             # global // num_replicas (ref main.py:725)
+    representation_size: int                # derived from arch registry (fixes Q8)
+
+    @property
+    def global_batch_size(self) -> int:
+        return self.cfg.task.batch_size
+
+
+def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
+            output_size: int, input_shape: Tuple[int, int, int],
+            representation_size: Optional[int] = None) -> ResolvedConfig:
+    """Derive load-bearing quantities exactly as the reference does.
+
+    Reference math (main.py:420-425,725):
+      - per-replica batch  = global_batch // num_replicas
+      - per-replica train samples = num_train_samples // num_replicas
+      - steps_per_train_epoch = per_replica_samples // per_replica_batch  (drop remainder)
+      - total_train_steps = epochs * steps_per_train_epoch
+    These feed the EMA tau schedule (main.py:160,425) so they must match.
+    """
+    n_rep = cfg.device.num_replicas
+    if cfg.task.batch_size % n_rep != 0:
+        raise ValueError(
+            f"global batch {cfg.task.batch_size} not divisible by "
+            f"num_replicas {n_rep}")
+    per_replica_batch = cfg.task.batch_size // n_rep
+    per_replica_train = num_train_samples // n_rep
+    steps_per_epoch = per_replica_train // per_replica_batch
+    if steps_per_epoch == 0:
+        raise ValueError(
+            f"steps_per_train_epoch is 0: {per_replica_train} per-replica "
+            f"samples < per-replica batch {per_replica_batch}")
+    rep_size = representation_size
+    if rep_size is None:
+        # Derive from the backbone registry (the Quirk Q8 fix) — the config
+        # field is only a fallback for archs not yet registered.
+        try:
+            from byol_tpu.models.registry import get_spec
+            rep_size = get_spec(cfg.model.arch).feature_dim
+        except ValueError:
+            rep_size = cfg.model.representation_size
+    return ResolvedConfig(
+        cfg=cfg,
+        input_shape=tuple(input_shape),
+        num_train_samples=per_replica_train,
+        num_test_samples=num_test_samples,
+        output_size=output_size,
+        steps_per_train_epoch=steps_per_epoch,
+        total_train_steps=cfg.task.epochs * steps_per_epoch,
+        batch_size_per_replica=per_replica_batch,
+        representation_size=rep_size,
+    )
+
+
+def run_name(cfg: Config) -> str:
+    """Deterministic run name from config + uid.
+
+    Contract of ``helpers.utils.get_name(args)`` (reference main.py:454,460):
+    run identity names the TB logdir / checkpoint dir.
+    """
+    blob = cfg.to_json().encode()
+    digest = hashlib.sha1(blob).hexdigest()[:8]
+    uid = cfg.task.uid or "byol"
+    return f"{uid}_{cfg.model.arch}_b{cfg.task.batch_size}_{digest}"

@@ -1,0 +1,47 @@
+"""Mixed-precision dtype policy.
+
+TPU-native replacement for NVIDIA apex AMP O2 (reference main.py:122-124,
+745-746, 613-617): compute in bfloat16, keep params / BN statistics / EMA
+trees in float32.  bf16 has fp32's exponent range, so the apex loss-scaling
+machinery (amp.scale_loss, main.py:614-615) has no TPU equivalent and is
+intentionally absent.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: jnp.dtype = jnp.float32
+
+    def cast_to_compute(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+    def cast_to_param(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.param_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+    def cast_to_output(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.output_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+FP32 = Policy()
+# apex-O2 analog: bf16 activations/compute, fp32 master params + BN stats.
+BF16 = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+              output_dtype=jnp.float32)
+
+
+def get_policy(half: bool) -> Policy:
+    """Map the reference's ``--half`` flag (main.py:116-117) to a policy."""
+    return BF16 if half else FP32

@@ -1,0 +1,33 @@
+"""RNG plumbing.
+
+Replaces the reference's ad-hoc global seeding (main.py:710-715: numpy + torch
++ cuda manual_seed) with explicit JAX PRNG key threading.  Keys are split
+per-purpose and per-step; data augmentation keys are additionally folded with
+the step counter so every step sees fresh, reproducible randomness — the
+analog of DistributedSampler's epoch reseed (main.py:760).
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def split_named(key: jax.Array, names: Sequence[str]) -> Dict[str, jax.Array]:
+    keys = jax.random.split(key, len(names))
+    return dict(zip(names, keys))
+
+
+def for_step(key: jax.Array, step) -> jax.Array:
+    """Per-step derived key; `step` may be a traced int32 scalar."""
+    return jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
+
+
+def per_device_key(key: jax.Array, axis_index) -> jax.Array:
+    """Decorrelate per-device randomness inside shard_map/pmap bodies."""
+    return jax.random.fold_in(key, axis_index)

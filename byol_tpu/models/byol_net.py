@@ -1,0 +1,74 @@
+"""The BYOL network: backbone + projector + predictor + linear probe.
+
+Functional redesign of the reference ``BYOL(nn.Module)`` (main.py:167-276).
+The reference realizes the target network by swapping an EMA parameter vector
+into the live module and back (main.py:214-227) — 2 parameters_to_vector + 4
+vector_to_parameters full copies per step.  Here the network is a pure
+function of its parameter pytree, so the target is simply *a second pytree*
+passed to the same ``apply`` — zero copies (SURVEY.md §3.2 hot-loop note).
+
+Following the reference, the EMA later covers the FULL parameter tree
+(backbone + heads + probe; reference EMAs ``parameters_to_vector(
+self.parameters())``, main.py:211-212,255), even though only backbone +
+projector matter for the target branch.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from byol_tpu.models.heads import LinearProbe, MLPHead
+
+
+class BYOLNet(nn.Module):
+    backbone: nn.Module
+    num_classes: int
+    head_latent_size: int = 4096       # --head-latent-size (main.py:63-64)
+    projection_size: int = 256         # --projection-size (main.py:61-62)
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.projector = MLPHead(hidden_size=self.head_latent_size,
+                                 output_size=self.projection_size,
+                                 dtype=self.dtype, name="projector")
+        self.predictor = MLPHead(hidden_size=self.head_latent_size,
+                                 output_size=self.projection_size,
+                                 dtype=self.dtype, name="predictor")
+        self.probe = LinearProbe(num_classes=self.num_classes,
+                                 dtype=self.dtype, name="probe")
+
+    def __call__(self, x, train: bool = True) -> Dict[str, jnp.ndarray]:
+        """One view through encoder/projector/predictor — the analog of the
+        reference ``prediction()`` (main.py:229-240)."""
+        representation = self.backbone(x, train=train)
+        projection = self.projector(representation, train=train)
+        prediction = self.predictor(projection, train=train)
+        return {"representation": representation,
+                "projection": projection,
+                "prediction": prediction}
+
+    def classify(self, representation):
+        """Linear probe on stop-gradient features (main.py:249-252)."""
+        return self.probe(representation)
+
+    def warmup(self, x, train: bool = True):
+        """Touch every submodule so ``init`` materializes all parameters —
+        the analog of the reference's ``lazy_generate_modules`` warmup
+        forward (main.py:465-499)."""
+        out = self(x, train=train)
+        logits = self.classify(out["representation"])
+        return out, logits
+
+
+def build_byol_net(arch: str, *, num_classes: int, head_latent_size: int,
+                   projection_size: int, dtype=jnp.float32,
+                   small_inputs: bool = False, **backbone_kwargs) -> "BYOLNet":
+    from byol_tpu.models.registry import get_backbone
+    backbone, _ = get_backbone(arch, dtype=dtype, small_inputs=small_inputs,
+                               **backbone_kwargs)
+    return BYOLNet(backbone=backbone, num_classes=num_classes,
+                   head_latent_size=head_latent_size,
+                   projection_size=projection_size, dtype=dtype)

@@ -1,0 +1,42 @@
+"""Projector / predictor MLP heads and the linear probe.
+
+Shapes per the reference (main.py:194-205): projector and predictor are both
+``Linear(in -> head_latent) -> BatchNorm1d -> ReLU -> Linear(head_latent ->
+projection_size)``; the probe is a single Linear on stop-gradient features
+(main.py:208,249-252).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLPHead(nn.Module):
+    hidden_size: int = 4096
+    output_size: int = 256
+    dtype: jnp.dtype = jnp.float32
+    bn_momentum: float = 0.9
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Dense(self.hidden_size, dtype=self.dtype, name="dense1")(x)
+        x = nn.BatchNorm(use_running_average=not train,
+                         momentum=self.bn_momentum, name="bn")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.output_size, dtype=self.dtype, name="dense2")(x)
+        return x.astype(self.dtype)
+
+
+class LinearProbe(nn.Module):
+    """Concurrently-trained linear classifier on detached representations
+    (reference main.py:208,250-252; Quirk Q11)."""
+
+    num_classes: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, representation):
+        representation = jax.lax.stop_gradient(representation)
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="classifier")(representation)

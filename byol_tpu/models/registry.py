@@ -1,0 +1,92 @@
+"""Backbone registry with explicit feature-extractor contracts.
+
+Replaces the reference's "any lowercase callable in torchvision.models"
+discovery (main.py:30-32) + manual ``--representation-size`` matching
+(main.py:59-60, Quirk Q8).  Each entry yields a module whose ``__call__(x,
+train)`` returns pooled features, plus its feature dimension, plus whether
+the arch contains BatchNorm (drives LARS/weight-decay exclusion masks and
+lets the ViT path skip BN machinery cleanly).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from byol_tpu.models import resnet as resnet_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class BackboneSpec:
+    factory: Callable[..., nn.Module]    # (dtype, small_inputs) -> module
+    feature_dim: int
+    has_batchnorm: bool = True
+
+
+_REGISTRY: Dict[str, BackboneSpec] = {}
+
+
+def register(name: str, spec: BackboneSpec) -> None:
+    if name in _REGISTRY:
+        raise ValueError(f"backbone {name!r} already registered")
+    _REGISTRY[name] = spec
+
+
+def available() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def get_spec(name: str) -> BackboneSpec:
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown arch {name!r}; available: {available()}")
+    return _REGISTRY[name]
+
+
+def get_backbone(name: str, *, dtype=jnp.float32, small_inputs: bool = False,
+                 **kwargs) -> Tuple[nn.Module, int]:
+    spec = get_spec(name)
+    module = spec.factory(dtype=dtype, small_inputs=small_inputs, **kwargs)
+    return module, spec.feature_dim
+
+
+def _register_resnets() -> None:
+    for name in ("resnet18", "resnet34", "resnet50", "resnet101",
+                 "resnet152", "resnet200", "resnet50w2", "resnet200w2"):
+        def factory(dtype=jnp.float32, small_inputs=False, _n=name, **kw):
+            return resnet_lib.make_resnet(_n, dtype=dtype,
+                                          small_inputs=small_inputs, **kw)
+        # single source of truth: the module computes its own feature dim
+        # from stage_sizes/width/expansion (resnet.py ResNet.feature_dim).
+        register(name, BackboneSpec(
+            factory=factory,
+            feature_dim=resnet_lib.make_resnet(name).feature_dim,
+            has_batchnorm=True))
+
+
+_register_resnets()
+
+
+def _register_vit() -> None:
+    # Deferred import keeps resnet-only users off the ViT module path.
+    from byol_tpu.models import vit as vit_lib
+    for name, (width, depth, heads, patch) in {
+            "vit_b16": (768, 12, 12, 16),
+            "vit_l16": (1024, 24, 16, 16),
+            "vit_s16": (384, 12, 6, 16),
+    }.items():
+        def factory(dtype=jnp.float32, small_inputs=False, _w=width, _d=depth,
+                    _h=heads, _p=patch, **kw):
+            del small_inputs, kw  # BN-free path: no resnet knobs apply
+            return vit_lib.ViT(width=_w, depth=_d, num_heads=_h, patch_size=_p,
+                               dtype=dtype)
+        register(name, BackboneSpec(factory=factory, feature_dim=width,
+                                    has_batchnorm=False))
+
+
+try:
+    _register_vit()
+except ImportError:  # pragma: no cover - vit module lands in a later commit
+    pass

@@ -1,0 +1,160 @@
+"""ResNet backbone family, NHWC / TPU-native.
+
+Replaces the reference's torchvision backbone zoo (reference main.py:30-32,
+190-193: ``models.__dict__[args.arch]`` with the final FC stripped via
+``children()[:-1]``).  Instead of truncating an opaque module list (Quirk Q8),
+every backbone here IS a feature extractor: ``__call__`` returns the pooled
+representation, and the registry (:mod:`byol_tpu.models.registry`) exposes the
+feature dimension so ``--representation-size`` no longer needs hand-matching.
+
+Architecture follows torchvision ResNet v1 semantics (7x7/2 stem, 3x3/2
+max-pool, post-activation residual blocks, global average pool) so trained
+behavior is comparable, but the implementation is JAX-idiomatic: NHWC layout
+(TPU-native), batch statistics computed over the GLOBAL batch under GSPMD jit
+— the sharded batch axis makes every BN a SyncBN (reference's opt-in
+``--convert-to-sync-bn``, main.py:77-78,433) with zero extra code.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """2x conv3x3 residual block (resnet18/34)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    zero_init_last_bn: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        last_scale = (nn.initializers.zeros_init() if self.zero_init_last_bn
+                      else nn.initializers.ones_init())
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides, padding=1,
+                      name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), padding=1, name="conv2")(y)
+        y = self.norm(scale_init=last_scale, name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1(x4) residual block (resnet50+)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    expansion: int = 4
+    zero_init_last_bn: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        last_scale = (nn.initializers.zeros_init() if self.zero_init_last_bn
+                      else nn.initializers.ones_init())
+        residual = x
+        y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides, padding=1,
+                      name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        out_filters = self.filters * self.expansion
+        y = self.conv(out_filters, (1, 1), name="conv3")(y)
+        # zero-init the last BN scale so blocks start as identity — standard
+        # large-batch trick (Goyal et al.); torchvision offers the same via
+        # zero_init_residual (off there by default — gate for parity).
+        y = self.norm(scale_init=last_scale, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(out_filters, (1, 1), self.strides,
+                                 name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Feature-extractor ResNet: ``(B, H, W, C) -> (B, feature_dim)``."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    width: int = 64                      # base width; 128 for the w2 variants
+    dtype: jnp.dtype = jnp.float32
+    bn_momentum: float = 0.9             # = 1 - torch momentum 0.1
+    bn_epsilon: float = 1e-5
+    small_inputs: bool = False           # CIFAR stem: 3x3/1, no max-pool
+    zero_init_residual: bool = True      # False = torchvision/reference init
+
+    @property
+    def feature_dim(self) -> int:
+        exp = getattr(self.block_cls, "expansion", 1)
+        return self.width * (2 ** (len(self.stage_sizes) - 1)) * exp
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                                 kernel_init=nn.initializers.he_normal())
+        # BN params/stats stay fp32 (param_dtype default); leaving dtype=None
+        # promotes bf16 inputs to fp32 for the statistics — the apex-O2 "BN in
+        # fp32" rule (SURVEY.md §2.4) by construction.
+        norm = functools.partial(nn.BatchNorm, use_running_average=not train,
+                                 momentum=self.bn_momentum,
+                                 epsilon=self.bn_epsilon)
+        if self.small_inputs:
+            x = conv(self.width, (3, 3), padding=1, name="stem_conv")(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), padding=3, name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(filters=self.width * 2 ** i,
+                                   strides=strides, conv=conv, norm=norm,
+                                   zero_init_last_bn=self.zero_init_residual,
+                                   name=f"stage{i + 1}_block{j + 1}")(x)
+        x = jnp.mean(x, axis=(1, 2))     # global average pool
+        return x.astype(self.dtype)
+
+
+STAGE_SIZES = {
+    "resnet18": [2, 2, 2, 2],
+    "resnet34": [3, 4, 6, 3],
+    "resnet50": [3, 4, 6, 3],
+    "resnet101": [3, 4, 23, 3],
+    "resnet152": [3, 8, 36, 3],
+    "resnet200": [3, 24, 36, 3],
+}
+BASIC = {"resnet18", "resnet34"}
+
+
+def make_resnet(name: str, *, dtype=jnp.float32, width_multiplier: int = 1,
+                small_inputs: bool = False,
+                zero_init_residual: bool = True) -> ResNet:
+    base = name.replace("w2", "")
+    if base not in STAGE_SIZES:
+        raise ValueError(f"unknown resnet arch {name!r}; "
+                         f"known: {sorted(STAGE_SIZES)} (+'w2' suffix)")
+    if name.endswith("w2"):
+        width_multiplier = 2
+    block = BasicBlock if base in BASIC else Bottleneck
+    return ResNet(stage_sizes=STAGE_SIZES[base], block_cls=block,
+                  width=64 * width_multiplier, dtype=dtype,
+                  small_inputs=small_inputs,
+                  zero_init_residual=zero_init_residual)

@@ -1,0 +1,47 @@
+"""BYOL regression objective.
+
+Reference: /root/reference/objective.py:6-25.  The reference normalizes by
+*whole-tensor* Frobenius norms (``x.norm()`` with no dim — objective.py:8-9),
+which couples per-sample losses through batch statistics and deviates from
+the paper's per-row l2 normalization (Quirk Q2).  Both behaviors are
+implemented behind ``norm_mode``:
+
+- ``"paper"``     : per-sample l2 normalize, loss_i = -2 <x_i/|x_i|, y_i/|y_i|>
+- ``"reference"`` : -2 * sum(x*y, -1) / (|X|_F * |Y|_F), matching the
+                    reference bit-for-bit (golden-tested against it).
+
+``loss_function`` symmetrizes over the two views and stop-gradients the
+target projections (objective.py:23-24), then takes the batch mean
+(objective.py:25).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def regression_loss(x: jnp.ndarray, y: jnp.ndarray,
+                    norm_mode: str = "paper") -> jnp.ndarray:
+    """Per-sample negative scaled dot product, shape (B,)."""
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    if norm_mode == "paper":
+        x = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-12)
+        y = y / (jnp.linalg.norm(y, axis=-1, keepdims=True) + 1e-12)
+        return -2.0 * jnp.sum(x * y, axis=-1)
+    elif norm_mode == "reference":
+        norm_x = jnp.linalg.norm(x)      # whole-tensor Frobenius norm
+        norm_y = jnp.linalg.norm(y)      # (objective.py:8)
+        return -2.0 * jnp.sum(x * y, axis=-1) / (norm_x * norm_y)
+    raise ValueError(f"unknown norm_mode {norm_mode!r}")
+
+
+def loss_function(online_prediction1, online_prediction2,
+                  target_projection1, target_projection2,
+                  norm_mode: str = "paper") -> jnp.ndarray:
+    """Symmetrized BYOL loss, scalar (objective.py:12-25)."""
+    t1 = jax.lax.stop_gradient(target_projection1)
+    t2 = jax.lax.stop_gradient(target_projection2)
+    loss_ab = regression_loss(online_prediction1, t2, norm_mode)
+    loss_ba = regression_loss(online_prediction2, t1, norm_mode)
+    return jnp.mean(loss_ab + loss_ba)

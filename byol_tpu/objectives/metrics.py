@@ -1,0 +1,33 @@
+"""Classification losses and metrics for the concurrent linear probe.
+
+Replaces ``F.cross_entropy`` + ``helpers.metrics.topk`` usage at reference
+main.py:596-598.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.lax as lax
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy over integer labels."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels).mean()
+
+
+def topk_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
+                  topk: Sequence[int] = (1, 5)) -> Tuple[jnp.ndarray, ...]:
+    """Top-k accuracies in PERCENT, the ``helpers.metrics.topk`` contract
+    consumed at reference main.py:598 (logged as top1/top5)."""
+    maxk = min(max(topk), logits.shape[-1])
+    _, pred = lax.top_k(logits.astype(jnp.float32), maxk)   # (B, maxk)
+    correct = (pred == labels[:, None])
+    out = []
+    for k in topk:
+        k_eff = min(k, maxk)
+        acc = jnp.any(correct[:, :k_eff], axis=-1).astype(jnp.float32).mean()
+        out.append(acc * 100.0)
+    return tuple(out)

@@ -1,0 +1,102 @@
+"""Optimizer factory / registry.
+
+Mirrors reference ``build_optimizer`` (main.py:303-344):
+- registry {rmsprop, adam, adadelta, sgd, momentum(0.9), lamb, lbfgs};
+- linear LR scaling to global batch for sgd/momentum (main.py:333-334);
+- ``lars_<name>`` prefix composes LARS around the base optimizer with eps=0
+  (main.py:323,339-340);
+- weight decay routed through ``add_weight_decay`` semantics: bias/BN params
+  undecayed + excluded from LARS adaptation (SURVEY.md §2.3).  For non-LARS
+  optimizers the reference passes wd to the torch optimizer's own decoupled-
+  from-nothing L2 (torch adds wd*p to the grad) — reproduced with
+  ``optax.add_decayed_weights`` before the base transform.
+- grad VALUE clipping before everything when ``clip > 0``
+  (main.py:619-622: ``clip_grad_value_``).
+
+The apex FusedLAMB path (main.py:324-326) maps to ``optax.lamb`` — XLA fuses
+the update; no custom CUDA needed (SURVEY.md §2.4).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import optax
+
+from byol_tpu.optim import lars as lars_lib
+from byol_tpu.optim import schedules as sched_lib
+
+
+def _base_optimizer(name: str, learning_rate) -> optax.GradientTransformation:
+    if name == "rmsprop":
+        # torch RMSprop defaults: alpha=0.99, eps=1e-8, no momentum.
+        return optax.rmsprop(learning_rate, decay=0.99, eps=1e-8)
+    if name == "adam":
+        return optax.adam(learning_rate)
+    if name == "adadelta":
+        return optax.adadelta(learning_rate)
+    if name == "sgd":
+        return optax.sgd(learning_rate)
+    if name == "momentum":
+        return optax.sgd(learning_rate, momentum=0.9)
+    if name == "lamb":
+        return optax.lamb(learning_rate)
+    if name == "lbfgs":
+        raise NotImplementedError(
+            "lbfgs requires a line-search driver incompatible with the "
+            "jitted train step; reference lists it (main.py:317) but never "
+            "exercises it for BYOL")
+    raise ValueError(f"unknown optimizer {name!r}")
+
+
+def build_optimizer(opt_name: str, *,
+                    base_lr: float,
+                    global_batch_size: int,
+                    weight_decay: float,
+                    total_units: int,
+                    warmup_units: int,
+                    lr_schedule_kind: str = "cosine",
+                    steps_per_epoch: Optional[int] = None,
+                    clip: float = 0.0,
+                    trust_coefficient: float = 1e-3,
+                    lars_eps: float = 0.0,
+                    ) -> Tuple[optax.GradientTransformation, optax.Schedule]:
+    """Build the full gradient transformation + the lr schedule (returned
+    separately so the driver can log lr per epoch, main.py:763-764).
+
+    ``total_units``/``warmup_units`` are in schedule units; pass epochs and
+    set ``steps_per_epoch`` for reference-parity epoch-granular stepping
+    (Quirk Q5), or pass steps directly with ``steps_per_epoch=None``.
+    """
+    full = opt_name.lower().strip()
+    if full == "lars":
+        raise ValueError(
+            "bare 'lars' is a wrapper, not an optimizer; use lars_<base>, "
+            "e.g. 'lars_momentum' (the reference default, main.py:88-89)")
+    is_lars = full.startswith("lars_")
+    name = full.split("_")[-1] if is_lars else full
+
+    lr = sched_lib.linear_scaled_lr(base_lr, global_batch_size, name)
+    schedule = sched_lib.warmup_cosine(lr, warmup_units, total_units,
+                                       kind=lr_schedule_kind)
+    if steps_per_epoch is not None:
+        schedule = sched_lib.epoch_granular(schedule, steps_per_epoch)
+
+    base = _base_optimizer(name, schedule)
+
+    chain = []
+    if clip > 0.0:
+        chain.append(optax.clip(clip))
+    if is_lars:
+        chain.append(lars_lib.lars(
+            base, weight_decay=weight_decay,
+            trust_coefficient=trust_coefficient, eps=lars_eps))
+    else:
+        if weight_decay > 0.0:
+            # torch-style L2: grad += wd*p for every param (torch applies wd
+            # to ALL params when passed per-group; add_weight_decay gives the
+            # no-decay group wd=0, so mask bias/BN here identically).
+            chain.append(optax.add_decayed_weights(
+                weight_decay, mask=lars_lib.default_exclusion_mask))
+        chain.append(base)
+
+    return optax.chain(*chain), schedule

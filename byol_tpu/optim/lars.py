@@ -1,0 +1,111 @@
+"""LARS (layer-wise adaptive rate scaling) as an optax transform.
+
+Reference: /root/reference/optimizers/lars.py:8-127, a wrapper over an
+arbitrary torch optimizer.  Exact semantics reproduced (order matters):
+
+1. weight decay is folded into the gradient BEFORE the trust ratio
+   (lars.py:96-97: ``p.grad += weight_decay * p``), for every group whose
+   ``weight_decay > 0`` — bias/BN groups carry wd=0 so are untouched;
+2. the trust ratio ``trust_coef * |p| / (|g| + eps)`` multiplies the gradient
+   only for groups not flagged ``ignore`` (lars.py:100-108), i.e. only
+   matrix/conv kernels — bias and BN params are excluded (the
+   ``helpers.layers.add_weight_decay`` contract, SURVEY.md §2.3);
+3. the ratio is applied only when both norms are > 0, else 1.0
+   (lars.py:105-107);
+4. the inner optimizer then runs with its own lr and wd forced to 0
+   (lars.py:116-126) — here that is simply "don't add another wd transform".
+
+Defaults mirror the factory at reference main.py:339-340: ``eps=0.0``,
+``trust_coef=1e-3``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+MaskOrFn = Union[Any, Callable[[Any], Any]]
+
+
+def default_exclusion_mask(params) -> Any:
+    """True where LARS adaptation / weight decay applies.
+
+    Reproduces the bias/BN exclusion of ``add_weight_decay``: 1-D parameters
+    (biases, BN scale/bias) are excluded; kernels (ndim >= 2) are adapted.
+    """
+    return jax.tree_util.tree_map(lambda p: p.ndim > 1, params)
+
+
+def _resolve_mask(mask: Optional[MaskOrFn], params):
+    if mask is None:
+        return default_exclusion_mask(params)
+    if callable(mask):
+        return mask(params)
+    return mask
+
+
+class LarsState(NamedTuple):
+    pass
+
+
+def scale_by_lars_trust_ratio(trust_coefficient: float = 1e-3,
+                              eps: float = 0.0,
+                              mask: Optional[MaskOrFn] = None
+                              ) -> optax.GradientTransformation:
+    """Step 2-3 above: multiply masked gradients by the trust ratio."""
+
+    def init_fn(params):
+        del params
+        return LarsState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("LARS requires params")
+        m = _resolve_mask(mask, params)
+
+        def scale(g, p, use):
+            if not use:
+                return g
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            param_norm = jnp.linalg.norm(p32)
+            grad_norm = jnp.linalg.norm(g32)
+            ratio = jnp.where(
+                (param_norm > 0.0) & (grad_norm > 0.0),
+                trust_coefficient * param_norm / (grad_norm + eps),
+                1.0)
+            return (g32 * ratio).astype(g.dtype)
+
+        updates = jax.tree_util.tree_map(scale, updates, params, m)
+        return updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def lars_weight_decay(weight_decay: float,
+                      mask: Optional[MaskOrFn] = None
+                      ) -> optax.GradientTransformation:
+    """Step 1 above: fold wd into the gradient before adaptation
+    (lars.py:96-97).  Masked like the adaptation — bias/BN undecayed."""
+    if weight_decay <= 0.0:
+        return optax.identity()
+    return optax.add_decayed_weights(
+        weight_decay,
+        mask=(lambda p: _resolve_mask(mask, p)) if mask is None or callable(mask)
+        else mask)
+
+
+def lars(inner: optax.GradientTransformation,
+         weight_decay: float = 0.0,
+         trust_coefficient: float = 1e-3,
+         eps: float = 0.0,
+         mask: Optional[MaskOrFn] = None) -> optax.GradientTransformation:
+    """Compose wd fold-in + trust ratio + inner optimizer — the analog of
+    ``LARS(optimizer=...)`` wrapping at reference main.py:339-340."""
+    return optax.chain(
+        lars_weight_decay(weight_decay, mask),
+        scale_by_lars_trust_ratio(trust_coefficient, eps, mask),
+        inner,
+    )

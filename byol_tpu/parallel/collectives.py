@@ -1,0 +1,45 @@
+"""Named-axis collective helpers for shard_map bodies.
+
+The explicit-collective face of the comm backend (SURVEY.md §5.8).  Under the
+primary GSPMD/jit path these are unnecessary — XLA inserts all-reduces when a
+reduction crosses the sharded axis (that is how SyncBN and gradient reduction
+happen "for free").  shard_map bodies (ring attention, per-device-stat BN,
+tests that pin collective placement) use these wrappers so axis names stay
+consistent with :mod:`byol_tpu.parallel.mesh`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from byol_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
+
+
+def psum(x, axis_name: str = DATA_AXIS):
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str = DATA_AXIS):
+    return lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name: str = DATA_AXIS, axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def ppermute_shift(x, axis_name: str = SEQUENCE_AXIS, shift: int = 1):
+    """Ring shift along a mesh axis (ring-attention building block)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str = DATA_AXIS):
+    return lax.axis_index(axis_name)
+
+
+def grad_allreduce_mean(grads, axis_name: str = DATA_AXIS):
+    """DDP's bucketed NCCL gradient allreduce analog (reference
+    main.py:440-443) for explicit shard_map training bodies."""
+    return jax.tree_util.tree_map(lambda g: lax.pmean(g, axis_name), grads)

@@ -1,0 +1,102 @@
+"""Device mesh construction and multi-host rendezvous.
+
+TPU-native communication backend replacing the reference's NCCL stack
+(SURVEY.md §2.4, §5.8):
+
+- ``torch.distributed.init_process_group('nccl', init_method=MASTER_ADDR)``
+  (reference main.py:717-722) -> :func:`initialize_distributed`
+  (``jax.distributed.initialize`` with a coordinator address).
+- DDP gradient allreduce + SyncBN stat reduction -> XLA collectives inserted
+  by GSPMD when computations cross the sharded ``data`` axis; explicit
+  ``psum/pmean`` helpers live in :mod:`byol_tpu.parallel.collectives` for
+  shard_map bodies.
+- The process topology switch (reference main.py:786-814: mp.spawn vs
+  1-proc-per-node) collapses to "one process per host, all devices visible";
+  JAX owns device enumeration.
+
+Mesh axes:
+  ``data``     — data parallelism (the reference's only strategy);
+  ``model``    — tensor parallelism, size 1 for BYOL parity, reserved so TP
+                 can be enabled without re-plumbing (SURVEY.md §2.2);
+  ``sequence`` — sequence/context parallelism for the ViT / ring-attention
+                 path, size 1 by default.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQUENCE_AXIS = "sequence"
+AXIS_NAMES = (DATA_AXIS, SEQUENCE_AXIS, MODEL_AXIS)
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host rendezvous; the ``--distributed-master``/``--distributed-rank``
+    analog (reference main.py:105-109,794-797).  No-op for single process."""
+    if coordinator_address:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    data: int = -1          # -1: all remaining devices
+    sequence: int = 1
+    model: int = 1
+
+
+def build_mesh(spec: MeshSpec = MeshSpec(),
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    dp = spec.data
+    if dp == -1:
+        if n % (spec.sequence * spec.model) != 0:
+            raise ValueError(
+                f"{n} devices not divisible by sequence*model = "
+                f"{spec.sequence * spec.model}")
+        dp = n // (spec.sequence * spec.model)
+    if dp * spec.sequence * spec.model != n:
+        raise ValueError(
+            f"mesh {dp}x{spec.sequence}x{spec.model} != {n} devices")
+    arr = np.asarray(devices).reshape(dp, spec.sequence, spec.model)
+    return Mesh(arr, AXIS_NAMES)
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharded over the data axis; the DDP per-replica split analog
+    (reference main.py:725 divides the global batch per rank)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+def batch_pspec() -> P:
+    return P(DATA_AXIS)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Params/EMA/opt-state: replicated over every axis.  Replaces DDP's
+    buffer broadcast (reference main.py:440-443, Quirk Q12) — under SPMD the
+    replicas run identical programs, so replicated state stays bitwise
+    consistent by construction."""
+    return NamedSharding(mesh, P())
+
+
+def shard_batch_to_mesh(batch, mesh: Mesh):
+    """Place a host-global numpy batch onto the mesh, batch dim over 'data'."""
+    sh = data_sharding(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sh), batch)
+
+
+def local_device_count(mesh: Mesh) -> int:
+    return len([d for d in mesh.devices.flat
+                if d.process_index == jax.process_index()])

@@ -1,0 +1,118 @@
+"""Wiring: resolved config -> net, state, jitted steps on a mesh.
+
+The analog of the reference's ``build_loader_model_grapher`` +
+``build_optimizer`` wiring (main.py:403-462, 303-344), minus the loader/
+grapher (owned by :mod:`byol_tpu.data` / :mod:`byol_tpu.observability`).
+
+Sharding layout (GSPMD):
+- batch dims   : sharded over the ``data`` mesh axis;
+- params, target EMA, optimizer state, BN stats: replicated (the reference
+  keeps full replicas too — FSDP-style sharding is an extension, SURVEY §2.2).
+The jitted step constrains inputs/outputs to these shardings; XLA inserts all
+collectives (gradient allreduce, SyncBN psum) from the partitioning.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from byol_tpu.core.config import Config, ResolvedConfig
+from byol_tpu.core.precision import get_policy
+from byol_tpu.models.byol_net import BYOLNet, build_byol_net
+from byol_tpu.optim.factory import build_optimizer
+from byol_tpu.parallel.mesh import DATA_AXIS
+from byol_tpu.training.state import TrainState, create_train_state
+from byol_tpu.training.steps import StepConfig, make_eval_step, make_train_step
+
+
+def build_net(rcfg: ResolvedConfig) -> BYOLNet:
+    cfg = rcfg.cfg
+    policy = get_policy(cfg.device.half)
+    small = rcfg.input_shape[0] <= 64    # CIFAR-style stem
+    from byol_tpu.models.registry import get_spec
+    extra = ({"zero_init_residual": cfg.parity.zero_init_residual}
+             if get_spec(cfg.model.arch).has_batchnorm else {})
+    return build_byol_net(
+        cfg.model.arch,
+        num_classes=rcfg.output_size,
+        head_latent_size=cfg.model.head_latent_size,
+        projection_size=cfg.model.projection_size,
+        dtype=policy.compute_dtype,
+        small_inputs=small,
+        **extra)
+
+
+def init_variables(net: BYOLNet, rcfg: ResolvedConfig, rng: jax.Array):
+    h, w, c = rcfg.input_shape
+    dummy = jnp.zeros((2, h, w, c), jnp.float32)
+    return net.init({"params": rng}, dummy, train=True, method="warmup")
+
+
+def build_tx(rcfg: ResolvedConfig):
+    cfg = rcfg.cfg
+    epoch_granular = cfg.parity.schedule_granularity == "epoch"
+    return build_optimizer(
+        cfg.optim.optimizer,
+        base_lr=cfg.optim.lr,
+        global_batch_size=rcfg.global_batch_size,
+        weight_decay=cfg.regularizer.weight_decay,
+        # schedule units are epochs (warmup=10 epochs, main.py:87,290-291);
+        # step granularity interpolates the same shape per step.
+        total_units=(cfg.task.epochs if epoch_granular
+                     else rcfg.total_train_steps),
+        warmup_units=(cfg.optim.warmup if epoch_granular
+                      else cfg.optim.warmup * rcfg.steps_per_train_epoch),
+        lr_schedule_kind=cfg.optim.lr_update_schedule,
+        steps_per_epoch=(rcfg.steps_per_train_epoch if epoch_granular
+                         else None),
+        clip=cfg.optim.clip)
+
+
+def step_config(rcfg: ResolvedConfig) -> StepConfig:
+    cfg = rcfg.cfg
+    return StepConfig(
+        total_train_steps=rcfg.total_train_steps,
+        base_decay=cfg.model.base_decay,
+        norm_mode=cfg.parity.loss_norm_mode,
+        fuse_views=cfg.model.fuse_views,
+        polyak_ema=cfg.regularizer.polyak_ema,
+        ema_update_mode=cfg.parity.ema_update_mode)
+
+
+def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array
+                   ) -> Tuple[BYOLNet, TrainState, Callable, Callable, Any]:
+    """Returns (net, sharded_state, jitted_train_step, jitted_eval_step,
+    lr_schedule)."""
+    cfg = rcfg.cfg
+    policy = get_policy(cfg.device.half)
+    net = build_net(rcfg)
+    tx, schedule = build_tx(rcfg)
+    scfg = step_config(rcfg)
+
+    with mesh:
+        variables = init_variables(net, rcfg, rng)
+        state = create_train_state(
+            variables, tx,
+            ema_init_mode=cfg.parity.ema_init_mode,
+            polyak_ema=cfg.regularizer.polyak_ema)
+
+    replicated = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(DATA_AXIS))
+    state = jax.device_put(state, replicated)
+
+    # Prefix-pytree shardings: whole state replicated, all batch leaves
+    # sharded on the data axis.
+    train_step = jax.jit(
+        make_train_step(net, tx, scfg, policy),
+        in_shardings=(replicated, batch_sh),
+        out_shardings=(replicated, replicated),
+        donate_argnums=(0,))
+    eval_step = jax.jit(
+        make_eval_step(net, scfg, policy),
+        in_shardings=(replicated, batch_sh),
+        out_shardings=replicated)
+    return net, state, train_step, eval_step, schedule

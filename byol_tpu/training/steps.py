@@ -1,0 +1,207 @@
+"""Jitted BYOL train / eval steps.
+
+TPU-first redesign of the reference hot path (``execute_graph``,
+main.py:559-692 + ``BYOL.forward``, main.py:242-276):
+
+- The target branch is the same ``apply`` with the EMA pytree — no parameter
+  vector swaps (SURVEY.md §3.2 flags 6 full-parameter copies per step in the
+  reference) and no wasted autodiff graph (targets are computed outside the
+  differentiated function, not built-then-detached).
+- Under GSPMD jit with the batch dim sharded over the ``data`` mesh axis,
+  every mean over the batch is a GLOBAL mean: gradient reduction (DDP's NCCL
+  allreduce, main.py:440-443) and SyncBN statistics (main.py:433) fall out of
+  partitioning — XLA inserts the ICI collectives.
+- ``fuse_views=True`` concatenates the two views into one encoder call
+  (2 forwards instead of 4, better MXU utilization).  This makes BN batch
+  statistics span both views, unlike the reference's per-view forwards
+  (main.py:244-247), so it is a perf opt-in.
+
+Semantics deltas from the reference, both deliberate and documented:
+- BN running stats are updated by the ONLINE forwards only; the reference
+  also mutates them during target forwards because buffers are not swapped
+  (main.py:214-227 swaps parameters only).  Affects eval-time stats slightly.
+- EMA update timing: reference updates the EMA with PRE-update params inside
+  forward (main.py:255, before optimizer.step()); the paper (and default
+  here) EMAs the POST-update params.  ``ema_update_mode='reference_pre'``
+  reproduces the reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from byol_tpu.core.precision import Policy, FP32
+from byol_tpu.objectives.byol_loss import loss_function
+from byol_tpu.objectives.metrics import cross_entropy, topk_accuracy
+from byol_tpu.optim.schedules import cosine_ema_decay
+from byol_tpu.training.state import TrainState
+
+
+@dataclasses.dataclass(frozen=True)
+class StepConfig:
+    total_train_steps: int
+    base_decay: float = 0.996            # --base-decay (main.py:65-66)
+    norm_mode: str = "paper"             # Quirk Q2 switch
+    fuse_views: bool = False
+    polyak_ema: float = 0.0
+    ema_update_mode: str = "post"        # 'post' | 'reference_pre'
+
+
+def _forward_views(net, params, batch_stats, aug1, aug2, *, train: bool,
+                   fuse: bool, update_stats: bool):
+    """Run both views through encoder+projector+predictor.
+
+    Returns (out1, out2, new_batch_stats); each out is the dict from
+    ``BYOLNet.__call__`` (representation/projection/prediction).
+    """
+    variables = {"params": params, "batch_stats": batch_stats}
+    # flax BatchNorm writes running stats whenever train=True, so the
+    # collection must be mutable even for the target forward; updates are
+    # simply discarded when update_stats=False.
+    mutable = ["batch_stats"] if train else False
+
+    def apply(v, x):
+        if mutable:
+            out, upd = net.apply(v, x, train=train, mutable=mutable)
+            new_bs = upd["batch_stats"] if update_stats else v["batch_stats"]
+            return out, new_bs
+        out = net.apply(v, x, train=train, mutable=False)
+        return out, v["batch_stats"]
+
+    if fuse:
+        n = aug1.shape[0]
+        out, bs = apply(variables, jnp.concatenate([aug1, aug2], axis=0))
+        out1 = jax.tree_util.tree_map(lambda x: x[:n], out)
+        out2 = jax.tree_util.tree_map(lambda x: x[n:], out)
+        return out1, out2, bs
+    out1, bs = apply(variables, aug1)
+    out2, bs = apply({"params": params, "batch_stats": bs}, aug2)
+    return out1, out2, bs
+
+
+def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
+                    policy: Policy = FP32
+                    ) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
+                                  Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """Build the jittable train step: (state, batch) -> (state, metrics).
+
+    ``batch`` = {'view1': (B,H,W,C), 'view2': (B,H,W,C), 'label': (B,)},
+    pixels in [0,1] (the reference input contract, main.py:486-490).
+    """
+
+    def train_step(state: TrainState, batch):
+        aug1 = policy.cast_to_compute(batch["view1"])
+        aug2 = policy.cast_to_compute(batch["view2"])
+        labels = batch["label"]
+
+        # Target branch: outside the differentiated function — autodiff never
+        # sees it (vs reference building + detaching the graph, Quirk Q10).
+        tgt1, tgt2, _ = _forward_views(
+            net, state.target_params, state.batch_stats, aug1, aug2,
+            train=True, fuse=scfg.fuse_views, update_stats=False)
+        target_proj1 = jax.lax.stop_gradient(tgt1["projection"])
+        target_proj2 = jax.lax.stop_gradient(tgt2["projection"])
+
+        def loss_fn(params):
+            on1, on2, new_bs = _forward_views(
+                net, params, state.batch_stats, aug1, aug2,
+                train=True, fuse=scfg.fuse_views, update_stats=True)
+            byol_loss = loss_function(
+                on1["prediction"], on2["prediction"],
+                target_proj1, target_proj2, norm_mode=scfg.norm_mode)
+            # Probe on stop-grad features of both views; labels doubled in
+            # train mode (main.py:249-252,596-597, Quirk Q11).
+            reprs = jnp.concatenate(
+                [on1["representation"], on2["representation"]], axis=0)
+            logits = net.apply({"params": params}, reprs,
+                               method="classify")
+            cls_labels = jnp.concatenate([labels, labels], axis=0)
+            cls_loss = cross_entropy(logits, cls_labels)
+            total = byol_loss + cls_loss
+            top1, top5 = topk_accuracy(logits, cls_labels)
+            metrics = {"loss_mean": total,
+                       "byol_loss_mean": byol_loss,
+                       "linear_loss_mean": cls_loss,
+                       "top1_mean": top1,
+                       "top5_mean": top5}
+            return total, (new_bs, metrics)
+
+        grads, (new_bs, metrics) = jax.grad(
+            loss_fn, has_aux=True)(state.params)
+        grads = policy.cast_to_param(grads)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        # Cosine-annealed EMA of the full tree (main.py:156-162,255).
+        tau = cosine_ema_decay(state.ema_step, scfg.total_train_steps,
+                               scfg.base_decay)
+        ema_src = (state.params if scfg.ema_update_mode == "reference_pre"
+                   else new_params)
+        new_target = jax.tree_util.tree_map(
+            lambda t, p: tau * t + (1.0 - tau) * p,
+            state.target_params, ema_src)
+
+        new_polyak = state.polyak_params
+        if scfg.polyak_ema > 0.0 and state.polyak_params is not None:
+            d = scfg.polyak_ema
+            new_polyak = jax.tree_util.tree_map(
+                lambda m, p: d * m + (1.0 - d) * p,
+                state.polyak_params, new_params)
+
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_bs,
+            target_params=new_target,
+            ema_step=state.ema_step + 1,
+            opt_state=new_opt_state,
+            polyak_params=new_polyak,
+        )
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(net, scfg: StepConfig, policy: Policy = FP32):
+    """Eval step per reference semantics (main.py:574-606, §3.3): full BYOL
+    loss computed in eval too; probe sees only view-1 representations with
+    un-doubled labels (main.py:250-251); EMA frozen; BN uses running stats;
+    Polyak params used for prediction when enabled (main.py:585-587)."""
+
+    def eval_step(state: TrainState, batch):
+        aug1 = policy.cast_to_compute(batch["view1"])
+        aug2 = policy.cast_to_compute(batch["view2"])
+        labels = batch["label"]
+
+        params = state.params
+        if scfg.polyak_ema > 0.0 and state.polyak_params is not None:
+            params = state.polyak_params
+
+        on1, on2, _ = _forward_views(
+            net, params, state.batch_stats, aug1, aug2,
+            train=False, fuse=scfg.fuse_views, update_stats=False)
+        tgt1, tgt2, _ = _forward_views(
+            net, state.target_params, state.batch_stats, aug1, aug2,
+            train=False, fuse=scfg.fuse_views, update_stats=False)
+
+        byol_loss = loss_function(
+            on1["prediction"], on2["prediction"],
+            tgt1["projection"], tgt2["projection"], norm_mode=scfg.norm_mode)
+        logits = net.apply({"params": params}, on1["representation"],
+                           method="classify")
+        cls_loss = cross_entropy(logits, labels)
+        top1, top5 = topk_accuracy(logits, labels)
+        return {"loss_mean": byol_loss + cls_loss,
+                "byol_loss_mean": byol_loss,
+                "linear_loss_mean": cls_loss,
+                "top1_mean": top1,
+                "top5_mean": top5}
+
+    return eval_step

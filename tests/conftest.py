@@ -1,0 +1,37 @@
+"""Test harness: simulate an 8-device TPU-like mesh on CPU.
+
+The reference had no tests and could only validate multi-node behavior by
+launching on SLURM (SURVEY.md §4).  JAX lets us run the full SPMD program on
+N virtual CPU devices instead — this must be configured before jax imports.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # the session env pins 'axon' (real TPU)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+# sitecustomize.py pre-imports jax before this conftest runs, freezing the
+# env-derived config; override through the config API (the XLA backend itself
+# is still uninitialized at this point, so this takes effect).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from byol_tpu.parallel.mesh import MeshSpec, build_mesh
+    return build_mesh(MeshSpec(data=8))
+
+
+@pytest.fixture(scope="session")
+def mesh_dp_sp():
+    """4-way data x 2-way sequence mesh for context-parallel tests."""
+    from byol_tpu.parallel.mesh import MeshSpec, build_mesh
+    return build_mesh(MeshSpec(data=4, sequence=2))

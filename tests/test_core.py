@@ -1,0 +1,90 @@
+"""Core config / mesh / precision tests."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byol_tpu.core import config as config_lib
+from byol_tpu.core.precision import BF16, FP32, get_policy
+from byol_tpu.parallel.mesh import (MeshSpec, build_mesh, data_sharding,
+                                    replicated, shard_batch_to_mesh)
+
+
+def _cfg(**task):
+    c = config_lib.Config()
+    return c.replace(task=dataclasses.replace(c.task, **task))
+
+
+class TestResolve:
+    def test_reference_derivation_math(self):
+        # Reference math at main.py:420-425,725: global batch 1024 over 8
+        # replicas -> 128/replica; 50000 train samples -> 6250/replica;
+        # steps = 6250 // 128 = 48 (drop remainder); total = epochs * steps.
+        cfg = _cfg(batch_size=1024, epochs=100)
+        r = config_lib.resolve(cfg, num_train_samples=50000,
+                               num_test_samples=10000, output_size=10,
+                               input_shape=(224, 224, 3))
+        assert r.batch_size_per_replica == 128
+        assert r.num_train_samples == 6250
+        assert r.steps_per_train_epoch == 48
+        assert r.total_train_steps == 4800
+        assert r.num_test_samples == 10000  # test not sharded (main.py:422)
+
+    def test_indivisible_batch_raises(self):
+        cfg = _cfg(batch_size=100)
+        with pytest.raises(ValueError, match="not divisible"):
+            config_lib.resolve(cfg, num_train_samples=1000,
+                               num_test_samples=100, output_size=10,
+                               input_shape=(32, 32, 3))
+
+    def test_zero_steps_raises(self):
+        cfg = _cfg(batch_size=4096)
+        with pytest.raises(ValueError, match="steps_per_train_epoch"):
+            config_lib.resolve(cfg, num_train_samples=1000,
+                               num_test_samples=100, output_size=10,
+                               input_shape=(32, 32, 3))
+
+    def test_run_name_deterministic(self):
+        cfg = _cfg(uid="exp1")
+        assert config_lib.run_name(cfg) == config_lib.run_name(cfg)
+        cfg2 = _cfg(uid="exp1", batch_size=2048)
+        assert config_lib.run_name(cfg) != config_lib.run_name(cfg2)
+
+
+class TestMesh:
+    def test_build_8dev(self, mesh8):
+        assert mesh8.shape == {"data": 8, "sequence": 1, "model": 1}
+
+    def test_dp_sp_mesh(self, mesh_dp_sp):
+        assert mesh_dp_sp.shape == {"data": 4, "sequence": 2, "model": 1}
+
+    def test_bad_shape_raises(self):
+        with pytest.raises(ValueError):
+            build_mesh(MeshSpec(data=3))  # 8 devices not divisible
+
+    def test_shard_batch(self, mesh8):
+        x = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+        gx = shard_batch_to_mesh(x, mesh8)
+        assert gx.sharding == data_sharding(mesh8)
+        np.testing.assert_array_equal(np.asarray(gx), x)
+
+    def test_replicated_sharding(self, mesh8):
+        p = jax.device_put(jnp.ones((4, 4)), replicated(mesh8))
+        assert p.sharding.is_fully_replicated
+
+
+class TestPrecision:
+    def test_policy_selection(self):
+        assert get_policy(True) is BF16
+        assert get_policy(False) is FP32
+
+    def test_bf16_casts_only_floats(self):
+        tree = {"w": jnp.ones((2, 2), jnp.float32),
+                "i": jnp.ones((2,), jnp.int32)}
+        out = BF16.cast_to_compute(tree)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["i"].dtype == jnp.int32
+        back = BF16.cast_to_param(out)
+        assert back["w"].dtype == jnp.float32

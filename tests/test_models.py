@@ -1,0 +1,92 @@
+"""Model layer tests: backbone shapes, registry feature dims, heads."""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from byol_tpu.models import registry
+from byol_tpu.models.byol_net import build_byol_net
+from byol_tpu.models.heads import MLPHead
+from byol_tpu.models.resnet import make_resnet
+
+
+class TestRegistry:
+    def test_unknown_arch_raises(self):
+        with pytest.raises(ValueError, match="unknown arch"):
+            registry.get_spec("resnet9000")
+
+    @pytest.mark.parametrize("name,dim", [
+        ("resnet18", 512), ("resnet50", 2048), ("resnet50w2", 4096),
+    ])
+    def test_feature_dims_match_params(self, name, dim):
+        # The registry's declared dim must equal the module's actual output
+        # dim — this is the Quirk Q8 fix (no hand-matched
+        # --representation-size).
+        module, reg_dim = registry.get_backbone(name, small_inputs=True)
+        assert reg_dim == dim
+        variables = module.init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 32, 32, 3)), train=False)
+        out = module.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False,
+                           mutable=False)
+        assert out.shape == (2, dim)
+
+
+class TestResNet:
+    def test_resnet18_imagenet_stem_downsamples(self):
+        m = make_resnet("resnet18")
+        variables = m.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 64, 64, 3)), train=False)
+        out = m.apply(variables, jnp.ones((2, 64, 64, 3)), train=False,
+                      mutable=False)
+        assert out.shape == (2, 512)
+
+    def test_bn_updates_in_train_mode_only(self):
+        m = make_resnet("resnet18", small_inputs=True)
+        variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                           train=True)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        _, upd = m.apply(variables, x, train=True, mutable=["batch_stats"])
+        before = variables["batch_stats"]["stem_bn"]["mean"]
+        after = upd["batch_stats"]["stem_bn"]["mean"]
+        assert not jnp.allclose(before, after)
+        out_eval = m.apply(variables, x, train=False, mutable=False)
+        assert out_eval.shape == (4, 512)
+
+
+class TestHeads:
+    def test_mlp_head_shapes(self):
+        # Projector contract: Linear(rep->4096)+BN+ReLU+Linear(4096->256)
+        # (reference main.py:194-199).
+        head = MLPHead(hidden_size=4096, output_size=256)
+        variables = head.init(jax.random.PRNGKey(0), jnp.zeros((2, 512)),
+                              train=True)
+        k1 = variables["params"]["dense1"]["kernel"]
+        k2 = variables["params"]["dense2"]["kernel"]
+        assert k1.shape == (512, 4096) and k2.shape == (4096, 256)
+        out, _ = head.apply(variables, jnp.ones((3, 512)), train=True,
+                            mutable=["batch_stats"])
+        assert out.shape == (3, 256)
+
+
+class TestBYOLNet:
+    def test_forward_dict_and_probe_stopgrad(self):
+        net = build_byol_net("resnet18", num_classes=10,
+                            head_latent_size=64, projection_size=32,
+                            small_inputs=True)
+        x = jnp.ones((2, 32, 32, 3))
+        variables = net.init(jax.random.PRNGKey(0), x, train=True,
+                             method="warmup")
+        out, _ = net.apply(variables, x, train=True,
+                           mutable=["batch_stats"])
+        assert out["representation"].shape == (2, 512)
+        assert out["projection"].shape == (2, 32)
+        assert out["prediction"].shape == (2, 32)
+
+        # Probe gradient must not flow into the representation input
+        # (main.py:250-252 stop-grad; Quirk Q11).
+        def probe_loss(reprs):
+            logits = net.apply({"params": variables["params"]}, reprs,
+                               method="classify")
+            return jnp.sum(logits ** 2)
+
+        g = jax.grad(probe_loss)(jnp.ones((2, 512)))
+        assert jnp.allclose(g, 0.0)

@@ -1,0 +1,90 @@
+"""Objective golden tests.
+
+The 'reference' norm mode must match the reference formula
+(/root/reference/objective.py:6-25) computed independently with torch on the
+same inputs; the 'paper' mode must equal 2 - 2*cosine_similarity per sample.
+"""
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from byol_tpu.objectives.byol_loss import loss_function, regression_loss
+from byol_tpu.objectives.metrics import cross_entropy, topk_accuracy
+
+
+def _torch_reference_loss(p1, p2, t1, t2):
+    """Reference math (objective.py:8-9,23-25), written against torch as an
+    independent oracle: -2*sum(x*y,-1)/(|X|_F*|Y|_F), symmetrized, mean."""
+    def reg(x, y):
+        return -2 * torch.sum(x * y, dim=-1) / (x.norm() * y.norm())
+    return torch.mean(reg(p1, t2) + reg(p2, t1)).item()
+
+
+class TestReferenceMode:
+    def test_matches_torch_oracle(self):
+        rng = np.random.RandomState(0)
+        p1, p2, t1, t2 = [rng.randn(8, 16).astype(np.float32)
+                          for _ in range(4)]
+        ours = loss_function(jnp.asarray(p1), jnp.asarray(p2),
+                             jnp.asarray(t1), jnp.asarray(t2),
+                             norm_mode="reference")
+        golden = _torch_reference_loss(*map(torch.from_numpy,
+                                            (p1, p2, t1, t2)))
+        np.testing.assert_allclose(float(ours), golden, rtol=1e-5)
+
+    def test_batch_coupling_quirk(self):
+        # Quirk Q2: in reference mode, per-sample losses are coupled through
+        # the whole-tensor norms — scaling ONE row changes every row's loss.
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+        y = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+        base = regression_loss(x, y, "reference")
+        x2 = x.at[0].multiply(100.0)
+        pert = regression_loss(x2, y, "reference")
+        assert not np.allclose(base[1:], pert[1:])
+        # paper mode: rows independent
+        base_p = regression_loss(x, y, "paper")
+        pert_p = regression_loss(x2, y, "paper")
+        np.testing.assert_allclose(base_p[1:], pert_p[1:], rtol=1e-6)
+
+
+class TestPaperMode:
+    def test_equals_neg2_cosine(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randn(8, 16).astype(np.float32)
+        ours = regression_loss(jnp.asarray(x), jnp.asarray(y), "paper")
+        cos = torch.nn.functional.cosine_similarity(
+            torch.from_numpy(x), torch.from_numpy(y), dim=-1).numpy()
+        np.testing.assert_allclose(np.asarray(ours), -2.0 * cos, rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_aligned_vectors_minimize(self):
+        x = jnp.ones((4, 8))
+        assert np.allclose(regression_loss(x, x, "paper"), -2.0, atol=1e-5)
+        assert np.allclose(regression_loss(x, -x, "paper"), 2.0, atol=1e-5)
+
+
+class TestMetrics:
+    def test_topk_percent(self):
+        logits = jnp.asarray([[9.0, 1.0, 0.0, 0.0, 0.0, 0.5],
+                              [0.0, 9.0, 1.0, 0.2, 0.1, 0.3],
+                              [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]])
+        labels = jnp.asarray([0, 2, 0])
+        top1, top5 = topk_accuracy(logits, labels)
+        assert float(top1) == pytest_approx(1 / 3 * 100)
+        assert float(top5) == pytest_approx(2 / 3 * 100)
+
+    def test_cross_entropy_matches_torch(self):
+        rng = np.random.RandomState(3)
+        logits = rng.randn(8, 10).astype(np.float32)
+        labels = rng.randint(0, 10, size=(8,))
+        ours = float(cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+        golden = torch.nn.functional.cross_entropy(
+            torch.from_numpy(logits), torch.from_numpy(labels)).item()
+        np.testing.assert_allclose(ours, golden, rtol=1e-5)
+
+
+def pytest_approx(x, rel=1e-5):
+    import pytest
+    return pytest.approx(x, rel=rel)

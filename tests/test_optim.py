@@ -1,0 +1,141 @@
+"""Optimizer tests: LARS trust ratio vs hand-computed values, exclusion
+masks, schedules, factory composition."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from byol_tpu.optim.factory import build_optimizer
+from byol_tpu.optim.lars import (default_exclusion_mask, lars,
+                                 scale_by_lars_trust_ratio)
+from byol_tpu.optim.schedules import (cosine_ema_decay, epoch_granular,
+                                      linear_scaled_lr, warmup_cosine)
+
+
+class TestLars:
+    def test_trust_ratio_hand_computed(self):
+        # reference lars.py:102-108: g' = g * trust_coef*|p|/(|g_wd|+eps)
+        params = {"kernel": jnp.asarray([[3.0, 4.0]])}      # |p| = 5
+        grads = {"kernel": jnp.asarray([[0.6, 0.8]])}       # |g| = 1
+        tx = scale_by_lars_trust_ratio(trust_coefficient=0.001, eps=0.0)
+        out, _ = tx.update(grads, tx.init(params), params)
+        np.testing.assert_allclose(
+            np.asarray(out["kernel"]),
+            np.asarray(grads["kernel"]) * 0.001 * 5.0, rtol=1e-6)
+
+    def test_zero_norm_ratio_is_identity(self):
+        # lars.py:105-107: adaptive_lr stays 1.0 unless both norms > 0.
+        params = {"kernel": jnp.zeros((2, 2))}
+        grads = {"kernel": jnp.ones((2, 2))}
+        tx = scale_by_lars_trust_ratio()
+        out, _ = tx.update(grads, tx.init(params), params)
+        np.testing.assert_allclose(np.asarray(out["kernel"]), 1.0)
+
+    def test_exclusion_mask_ndim_rule(self):
+        params = {"dense": {"kernel": jnp.ones((4, 4)),
+                            "bias": jnp.ones((4,))},
+                  "bn": {"scale": jnp.ones((4,)), "bias": jnp.ones((4,))}}
+        mask = default_exclusion_mask(params)
+        assert mask["dense"]["kernel"] is True
+        assert mask["dense"]["bias"] is False
+        assert mask["bn"]["scale"] is False
+
+    def test_bias_not_adapted_not_decayed(self):
+        params = {"kernel": jnp.asarray([[3.0, 4.0]]),
+                  "bias": jnp.asarray([1.0])}
+        grads = {"kernel": jnp.asarray([[0.6, 0.8]]),
+                 "bias": jnp.asarray([0.5])}
+        tx = lars(optax.sgd(1.0), weight_decay=0.1)
+        out, _ = tx.update(grads, tx.init(params), params)
+        # bias: plain SGD, no wd, no trust ratio -> update = -lr * g
+        np.testing.assert_allclose(np.asarray(out["bias"]), -0.5, rtol=1e-6)
+        # kernel: g_wd = g + 0.1*p; ratio = 1e-3*|p|/|g_wd|
+        g_wd = np.array([[0.6, 0.8]]) + 0.1 * np.array([[3.0, 4.0]])
+        ratio = 1e-3 * 5.0 / np.linalg.norm(g_wd)
+        np.testing.assert_allclose(np.asarray(out["kernel"]),
+                                   -g_wd * ratio, rtol=1e-5)
+
+
+class TestSchedules:
+    def test_warmup_then_cosine_shape(self):
+        # LinearWarmup semantics: factor t/warmup, first unit at 0
+        # (scheduler.py:45-62); cosine spans total-warmup afterwards.
+        s = warmup_cosine(1.0, warmup_units=10, total_units=110)
+        assert float(s(0)) == 0.0
+        assert float(s(5)) == pytest.approx(0.5)
+        assert float(s(10)) == pytest.approx(1.0)       # cosine start
+        assert float(s(60)) == pytest.approx(0.5)       # cosine midpoint
+        assert float(s(110)) == pytest.approx(0.0, abs=1e-6)
+
+    def test_fixed_schedule(self):
+        s = warmup_cosine(2.0, warmup_units=4, total_units=100, kind="fixed")
+        assert float(s(2)) == pytest.approx(1.0)
+        assert float(s(50)) == pytest.approx(2.0)
+
+    def test_unimplemented_kind_raises(self):
+        # parity: 'step' advertised but NotImplementedError (main.py:292-293)
+        with pytest.raises(NotImplementedError):
+            warmup_cosine(1.0, 1, 10, kind="step")
+
+    def test_epoch_granular_staircase(self):
+        s = epoch_granular(lambda e: jnp.asarray(e, jnp.float32), 100)
+        assert float(s(99)) == 0.0
+        assert float(s(100)) == 1.0
+        assert float(s(199)) == 1.0
+
+    def test_linear_lr_scaling_only_sgd_momentum(self):
+        # main.py:333-334
+        assert linear_scaled_lr(0.2, 4096, "momentum") == pytest.approx(3.2)
+        assert linear_scaled_lr(0.2, 4096, "sgd") == pytest.approx(3.2)
+        assert linear_scaled_lr(0.2, 4096, "adam") == 0.2
+
+    def test_cosine_ema_decay_curve(self):
+        # main.py:160: tau(0)=base, tau(K)=1
+        assert float(cosine_ema_decay(0, 100, 0.996)) == pytest.approx(0.996)
+        assert float(cosine_ema_decay(100, 100, 0.996)) == pytest.approx(1.0)
+        assert float(cosine_ema_decay(50, 100, 0.996)) == pytest.approx(
+            1 - (1 - 0.996) / 2)
+
+
+class TestFactory:
+    def _params(self):
+        return {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))}
+
+    @pytest.mark.parametrize("name", [
+        "sgd", "momentum", "adam", "rmsprop", "adadelta", "lamb",
+        "lars_momentum", "lars_sgd", "lars_adam"])
+    def test_registry_builds_and_steps(self, name):
+        tx, sched = build_optimizer(
+            name, base_lr=0.1, global_batch_size=256, weight_decay=1e-6,
+            total_units=100, warmup_units=10)
+        params = self._params()
+        state = tx.init(params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        updates, _ = tx.update(grads, state, params)
+        assert all(jnp.all(jnp.isfinite(u))
+                   for u in jax.tree_util.tree_leaves(updates))
+
+    def test_lbfgs_not_implemented(self):
+        with pytest.raises(NotImplementedError):
+            build_optimizer("lbfgs", base_lr=0.1, global_batch_size=256,
+                            weight_decay=0.0, total_units=10, warmup_units=0)
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError, match="unknown optimizer"):
+            build_optimizer("frobnicate", base_lr=0.1, global_batch_size=256,
+                            weight_decay=0.0, total_units=10, warmup_units=0)
+
+    def test_clip_applied_first(self):
+        # clip_grad_value_ analog (main.py:619-622): elementwise clamp.
+        tx, _ = build_optimizer(
+            "sgd", base_lr=1.0, global_batch_size=256, weight_decay=0.0,
+            total_units=10, warmup_units=0, lr_schedule_kind="fixed",
+            clip=0.5)
+        params = self._params()
+        grads = jax.tree_util.tree_map(lambda p: 10.0 * jnp.ones_like(p),
+                                       params)
+        updates, _ = tx.update(grads, tx.init(params), params)
+        # warmup_units=0 => factor 1 => lr=1*batch-scale... sgd scales lr:
+        # 256/256 = 1.0; update = -clip(g) = -0.5
+        np.testing.assert_allclose(np.asarray(updates["kernel"]), -0.5)
