@@ -1,0 +1,113 @@
+"""Benchmark: BYOL training-step throughput, images/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no throughput numbers (BASELINE.md), so the baseline
+here is measured in-process: a reference-faithful configuration (fp32, four
+separate encoder forwards with per-view BN batches — the semantics of
+/root/reference/main.py:244-247 — and pre-update EMA, main.py:255) versus the
+TPU-first default (bf16 compute, fused two-view forward).  ``vs_baseline`` is
+the speedup of the TPU-first path over that faithful translation on the same
+chip, i.e. what the TPU-native redesign buys.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
+           fuse_views: bool, ema_update_mode: str):
+    from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
+                                      ParityConfig, TaskConfig, resolve)
+    from byol_tpu.parallel.mesh import MeshSpec, build_mesh, shard_batch_to_mesh
+    from byol_tpu.training.build import setup_training
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh(MeshSpec(data=n_dev))
+    cfg = Config(
+        task=TaskConfig(task="fake", batch_size=batch_size * n_dev, epochs=100,
+                        image_size_override=image_size),
+        model=ModelConfig(arch=arch, fuse_views=fuse_views),
+        device=DeviceConfig(num_replicas=n_dev, half=half, seed=0),
+        parity=ParityConfig(ema_update_mode=ema_update_mode),
+    )
+    rcfg = resolve(cfg, num_train_samples=1_281_167, num_test_samples=50_000,
+                   output_size=1000,
+                   input_shape=(image_size, image_size, 3))
+    net, state, train_step, _, _ = setup_training(
+        rcfg, mesh, jax.random.PRNGKey(0))
+
+    b = cfg.task.batch_size
+    rng = np.random.RandomState(0)
+    batch = {
+        "view1": rng.rand(b, image_size, image_size, 3).astype(np.float32),
+        "view2": rng.rand(b, image_size, image_size, 3).astype(np.float32),
+        "label": rng.randint(0, 1000, size=(b,)).astype(np.int32),
+    }
+    batch = shard_batch_to_mesh(batch, mesh)
+    return state, train_step, batch
+
+
+def _throughput(batch_size: int, image_size: int, arch: str, *, half: bool,
+                fuse_views: bool, ema_update_mode: str,
+                steps: int = 20) -> float:
+    """Images/sec/chip for one configuration (global images / sec / n_dev)."""
+    state, train_step, batch = _build(
+        batch_size, image_size, arch, half=half, fuse_views=fuse_views,
+        ema_update_mode=ema_update_mode)
+    # warmup: compile + 2 steady steps
+    for _ in range(3):
+        state, metrics = train_step(state, batch)
+    jax.block_until_ready(metrics)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = train_step(state, batch)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+    n_dev = len(jax.devices())
+    global_batch = batch["label"].shape[0]
+    return global_batch * steps / dt / n_dev
+
+
+def main():
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        arch, image_size = "resnet50", 224
+        candidates = [256, 128, 64, 32]
+    else:  # CPU fallback so the bench never hard-fails off-hardware
+        arch, image_size = "resnet18", 32
+        candidates = [64, 32]
+
+    value = baseline = None
+    for bs in candidates:
+        try:
+            value = _throughput(bs, image_size, arch, half=True,
+                                fuse_views=True, ema_update_mode="post")
+            baseline = _throughput(bs, image_size, arch, half=False,
+                                   fuse_views=False,
+                                   ema_update_mode="reference_pre",
+                                   steps=10)
+            break
+        except Exception as e:  # OOM at this batch — try smaller
+            msg = str(e)
+            if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+                continue
+            raise
+    if value is None:
+        raise RuntimeError("no batch size fit in memory")
+
+    print(json.dumps({
+        "metric": f"{arch}_byol_train_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from byol_tpu.data.loader import LoaderBundle, get_loader  # noqa: F401
+from byol_tpu.data.prefetch import prefetch_to_mesh  # noqa: F401

@@ -1,0 +1,174 @@
+"""ImageFolder pipeline: class-per-subdirectory image trees (ImageNet layout).
+
+The reference's default task ``multi_augment_image_folder`` expects ``train/``
+and ``test/`` ImageFolder roots (/root/reference/README.md:82) and leans on
+NVIDIA DALI when host CPU decode becomes the bottleneck (main.py:356-382).
+TPU-native replacements here (SURVEY.md §2.4 DALI row):
+
+- fused ``decode_and_crop_jpeg``: the RandomResizedCrop window is sampled
+  FIRST and only that window is decoded — the single biggest host-CPU win
+  for JPEG trees;
+- per-host file sharding by ``jax.process_index()`` (DistributedSampler
+  analog);
+- parallel interleaved reads + AUTOTUNE-parallel augmentation + prefetch;
+  device transfer/double-buffering happens in the trainer
+  (data/prefetch.py).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterator, List, Tuple
+
+import numpy as np
+
+from byol_tpu.core.config import Config
+
+IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def scan_image_folder(root: str) -> Tuple[List[str], List[int], List[str]]:
+    """-> (paths, labels, class_names); classes sorted for determinism."""
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    if not classes:
+        raise FileNotFoundError(f"no class subdirectories under {root}")
+    paths, labels = [], []
+    for li, cls in enumerate(classes):
+        cdir = os.path.join(root, cls)
+        for fname in sorted(os.listdir(cdir)):
+            if fname.lower().endswith(IMG_EXTS):
+                paths.append(os.path.join(cdir, fname))
+                labels.append(li)
+    return paths, labels, classes
+
+
+def _decode_full(data, channels=3):
+    import tensorflow as tf
+    img = tf.io.decode_image(data, channels=channels, expand_animations=False)
+    img.set_shape([None, None, channels])
+    return tf.image.convert_image_dtype(img, tf.float32)
+
+
+def _fused_decode_random_crop(data, seed, size: int,
+                              scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+    """Sample the crop window from the JPEG header, decode ONLY the window
+    (tf.image.decode_and_crop_jpeg), then resize — DALI's fused
+    decode+crop equivalent on the host."""
+    import tensorflow as tf
+    shape = tf.image.extract_jpeg_shape(data)
+    bbox = tf.zeros((1, 1, 4), tf.float32)
+    begin, sz, _ = tf.image.stateless_sample_distorted_bounding_box(
+        shape, bounding_boxes=bbox, seed=seed, min_object_covered=0.0,
+        aspect_ratio_range=ratio, area_range=scale, max_attempts=10,
+        use_image_if_no_bounding_boxes=True)
+    oy, ox, _ = tf.unstack(begin)
+    th, tw, _ = tf.unstack(sz)
+    img = tf.image.decode_and_crop_jpeg(data, [oy, ox, th, tw], channels=3)
+    img = tf.image.convert_image_dtype(img, tf.float32)
+    return tf.image.resize(img, (size, size), method="bilinear")
+
+
+def _is_jpeg(path):
+    import tensorflow as tf
+    lower = tf.strings.lower(path)
+    return tf.strings.regex_full_match(lower, r".*\.(jpg|jpeg)")
+
+
+def image_folder_loader(cfg: Config, *, host_batch: int,
+                        shard_eval: bool = False):
+    """Build a LoaderBundle over train/ and test/ ImageFolder roots."""
+    import jax
+    import tensorflow as tf
+
+    from byol_tpu.data import augment
+    from byol_tpu.data.loader import LoaderBundle
+
+    size = cfg.task.image_size_override or 224
+    cj = cfg.regularizer.color_jitter_strength
+    seed = cfg.device.seed
+    index, count = jax.process_index(), jax.process_count()
+
+    roots = {}
+    for split in ("train", "test"):
+        root = os.path.join(cfg.task.data_dir, split)
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"image_folder task expects {root}/<class>/<img> "
+                f"(reference README.md:82)")
+        roots[split] = scan_image_folder(root)
+    tr_paths, tr_labels, classes = roots["train"]
+    te_paths, te_labels, te_classes = roots["test"]
+    if te_classes != classes:
+        raise ValueError("train/ and test/ class sets differ")
+    n_train, n_test = len(tr_paths), len(te_paths)
+
+    def shard(paths, labels):
+        return paths[index::count], labels[index::count]
+
+    tr_sh = shard(tr_paths, tr_labels)
+    te_sh = shard(te_paths, te_labels) if shard_eval else (te_paths, te_labels)
+
+    def make_iter(paths, labels, train: bool
+                  ) -> Callable[[int], Iterator[dict]]:
+        paths_t = np.asarray(paths)
+        labels_t = np.asarray(labels, np.int32)
+
+        def make(epoch: int):
+            ds = tf.data.Dataset.from_tensor_slices(
+                {"path": paths_t, "label": labels_t,
+                 "index": np.arange(len(labels_t), dtype=np.int64)})
+            if train:
+                ds = ds.shuffle(min(len(labels_t), 100_000),
+                                seed=seed + epoch,
+                                reshuffle_each_iteration=False)
+
+            def _load(ex):
+                data = tf.io.read_file(ex["path"])
+                if train:
+                    s0 = tf.stack([tf.cast(ex["index"], tf.int32),
+                                   tf.constant(seed, tf.int32) + epoch])
+                    views = []
+                    for vi in range(2):
+                        sv = tf.stack([s0[0] + 7919 * vi, s0[1]])
+                        crop = tf.cond(
+                            _is_jpeg(ex["path"]),
+                            lambda sv=sv: _fused_decode_random_crop(
+                                data, sv, size),
+                            lambda sv=sv: augment.random_resized_crop(
+                                _decode_full(data), size, sv))
+                        # remaining augs after the (possibly fused) crop
+                        seeds = augment._split(
+                            tf.stack([sv[0] + 104729, sv[1]]), 5)
+                        v = tf.image.stateless_random_flip_left_right(
+                            crop, seeds[0])
+                        v = tf.where(
+                            augment._uniform(seeds[1]) < 0.8,
+                            augment.color_jitter(v, cj, seeds[2]), v)
+                        v = augment.random_grayscale(v, seeds[3], p=0.2)
+                        v = tf.where(
+                            augment._uniform(seeds[4]) < 0.5,
+                            augment.gaussian_blur(v, int(0.1 * size),
+                                                  seeds[4]), v)
+                        v = tf.clip_by_value(
+                            tf.reshape(v, (size, size, 3)), 0.0, 1.0)
+                        views.append(v)
+                    return {"view1": views[0], "view2": views[1],
+                            "label": ex["label"]}
+                img = augment.test_resize(_decode_full(data), size)
+                return {"view1": img, "view2": img, "label": ex["label"]}
+
+            ds = ds.map(_load, num_parallel_calls=tf.data.AUTOTUNE)
+            ds = ds.batch(host_batch, drop_remainder=train)
+            ds = ds.prefetch(tf.data.AUTOTUNE)
+            return ds.as_numpy_iterator()
+
+        return make
+
+    return LoaderBundle(
+        make_train_iter=make_iter(*tr_sh, train=True),
+        make_test_iter=make_iter(*te_sh, train=False),
+        input_shape=(size, size, 3),
+        num_train_samples=n_train,
+        num_test_samples=n_test,
+        output_size=len(classes),
+    )

@@ -1,0 +1,173 @@
+"""Dataset loader bundle — the ``datasets.loader.get_loader`` contract.
+
+Reconstructed API surface (SURVEY.md §2.3; call sites
+/root/reference/main.py:24,413-423,430,475,579,760):
+
+  bundle = get_loader(cfg)          # dispatch on cfg.task.task
+  bundle.train_loader               # iterable of {'view1','view2','label'}
+  bundle.test_loader                # ditto (two resized views, Quirk Q9 note)
+  bundle.input_shape                # (H, W, C)
+  bundle.num_train_samples          # GLOBAL counts (resolve() divides per
+  bundle.num_test_samples           #  replica, core/config.py)
+  bundle.output_size                # number of classes
+  bundle.set_all_epochs(epoch)      # epoch reseed (DistributedSampler analog)
+
+TPU-native differences:
+- batches are dicts of numpy arrays sized for THIS HOST
+  (global_batch / process_count); the trainer shards them onto the mesh's
+  ``data`` axis (parallel/mesh.py), which is the per-replica split the
+  reference does by mutating args.batch_size (main.py:725);
+- the train set is sharded per host by ``jax.process_index()`` (the
+  DistributedSampler analog); test is NOT sharded, matching the reference
+  (main.py:422, Quirk Q9), unless ``shard_eval=True``;
+- iteration uses drop-remainder batching, matching steps_per_train_epoch
+  (main.py:424).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from byol_tpu.core.config import Config
+from byol_tpu.data import readers
+
+Batch = Dict[str, np.ndarray]
+
+
+@dataclasses.dataclass
+class LoaderBundle:
+    """Loader bundle; iterables re-seed from the epoch set via
+    ``set_all_epochs`` (reference main.py:760)."""
+
+    make_train_iter: Callable[[int], Iterator[Batch]]  # epoch -> iterator
+    make_test_iter: Callable[[int], Iterator[Batch]]
+    input_shape: Tuple[int, int, int]
+    num_train_samples: int
+    num_test_samples: int
+    output_size: int
+    epoch: int = 0
+
+    def set_all_epochs(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    @property
+    def train_loader(self) -> Iterator[Batch]:
+        return self.make_train_iter(self.epoch)
+
+    @property
+    def test_loader(self) -> Iterator[Batch]:
+        return self.make_test_iter(self.epoch)
+
+
+def _process_info() -> Tuple[int, int]:
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+def _shard_arrays(x: np.ndarray, y: np.ndarray, index: int, count: int):
+    """Contiguous per-host shard (DistributedSampler analog)."""
+    if count == 1:
+        return x, y
+    per = len(x) // count
+    lo = index * per
+    return x[lo:lo + per], y[lo:lo + per]
+
+
+def _array_pipeline(images: np.ndarray, labels: np.ndarray, *,
+                    batch_size: int, image_size: int, train: bool,
+                    color_jitter_strength: float, seed: int,
+                    shuffle: bool) -> Callable[[int], Iterator[Batch]]:
+    """tf.data pipeline over in-memory arrays -> numpy batch iterator.
+
+    Train: two independently-augmented views; test: one resize applied to
+    both view slots so eval code paths stay identical (the reference's eval
+    also runs the full two-view forward, main.py:589-606)."""
+    import tensorflow as tf
+
+    from byol_tpu.data import augment
+
+    def make(epoch: int) -> Iterator[Batch]:
+        ds = tf.data.Dataset.from_tensor_slices(
+            {"image": images, "label": labels.astype(np.int32),
+             "index": np.arange(len(labels), dtype=np.int64)})
+        if shuffle:
+            ds = ds.shuffle(min(len(labels), 50_000), seed=seed + epoch,
+                            reshuffle_each_iteration=False)
+
+        def _map(ex):
+            img = tf.image.convert_image_dtype(ex["image"], tf.float32)
+            if train:
+                s = tf.stack([tf.cast(ex["index"], tf.int32),
+                              tf.constant(seed, tf.int32) + epoch])
+                v1, v2 = augment.two_views(
+                    img, image_size, s, color_jitter_strength)
+            else:
+                v1 = augment.test_resize(img, image_size)
+                v2 = v1
+            return {"view1": v1, "view2": v2, "label": ex["label"]}
+
+        ds = ds.map(_map, num_parallel_calls=tf.data.AUTOTUNE)
+        ds = ds.batch(batch_size, drop_remainder=train)
+        ds = ds.prefetch(tf.data.AUTOTUNE)
+        return ds.as_numpy_iterator()
+
+    return make
+
+
+def get_loader(cfg: Config, *, num_fake_samples: int = 512,
+               shard_eval: bool = False) -> LoaderBundle:
+    """Dispatch on ``cfg.task.task``; see module docstring for the contract.
+
+    Tasks: 'fake', 'cifar10', 'cifar100', 'mnist', 'fashion_mnist',
+    'image_folder' (the reference's multi_augment_image_folder default,
+    main.py:38-39).
+    """
+    task = cfg.task.task
+    index, count = _process_info()
+    if cfg.task.batch_size % count != 0:
+        raise ValueError(f"global batch {cfg.task.batch_size} not divisible "
+                         f"by process count {count}")
+    host_batch = cfg.task.batch_size // count
+
+    if task == "image_folder":
+        from byol_tpu.data.imagefolder import image_folder_loader
+        return image_folder_loader(cfg, host_batch=host_batch,
+                                   shard_eval=shard_eval)
+
+    if task == "fake":
+        size = cfg.task.image_size_override or 32
+        x_tr, y_tr = readers.load_fake(num_fake_samples, size,
+                                       seed=cfg.device.seed)
+        x_te, y_te = readers.load_fake(max(num_fake_samples // 4, host_batch),
+                                       size, seed=cfg.device.seed + 1)
+        n_classes = 10
+    elif task in readers.ARRAY_LOADERS:
+        fn, n_classes = readers.ARRAY_LOADERS[task]
+        x_tr, y_tr = fn(cfg.task.data_dir, train=True,
+                        download=cfg.task.download)
+        x_te, y_te = fn(cfg.task.data_dir, train=False,
+                        download=cfg.task.download)
+        size = cfg.task.image_size_override or x_tr.shape[1]
+    else:
+        raise ValueError(f"unknown task {task!r}")
+
+    n_train, n_test = len(x_tr), len(x_te)
+    x_trs, y_trs = _shard_arrays(x_tr, y_tr, index, count)
+    if shard_eval:
+        x_te, y_te = _shard_arrays(x_te, y_te, index, count)
+
+    cj = cfg.regularizer.color_jitter_strength
+    return LoaderBundle(
+        make_train_iter=_array_pipeline(
+            x_trs, y_trs, batch_size=host_batch, image_size=size, train=True,
+            color_jitter_strength=cj, seed=cfg.device.seed, shuffle=True),
+        make_test_iter=_array_pipeline(
+            x_te, y_te, batch_size=host_batch, image_size=size, train=False,
+            color_jitter_strength=cj, seed=cfg.device.seed, shuffle=False),
+        input_shape=(size, size, 3),
+        num_train_samples=n_train,
+        num_test_samples=n_test,
+        output_size=n_classes,
+    )

@@ -1,0 +1,35 @@
+"""Device prefetch: double-buffer host batches into HBM.
+
+The DALI/`prefetch_to_device` analog (SURVEY.md §2.4): while the TPU runs
+step N, the next host batch is already being transferred, so the MXU never
+waits on PCIe/host.  Works with any iterator of numpy pytrees; placement uses
+the mesh ``data``-axis sharding so each device receives only its shard.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Iterator
+
+import jax
+from jax.sharding import Mesh
+
+from byol_tpu.parallel.mesh import shard_batch_to_mesh
+
+
+def prefetch_to_mesh(iterator: Iterator, mesh: Mesh, size: int = 2
+                     ) -> Iterator:
+    """Yield device-resident batches, keeping ``size`` in flight."""
+    queue = collections.deque()
+
+    def enqueue(n):
+        for _ in range(n):
+            batch = next(iterator, None)
+            if batch is None:
+                return
+            queue.append(shard_batch_to_mesh(batch, mesh))
+
+    enqueue(size)
+    while queue:
+        out = queue.popleft()
+        enqueue(1)
+        yield out

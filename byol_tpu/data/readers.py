@@ -1,0 +1,145 @@
+"""Array dataset readers: CIFAR-10/100, MNIST, FashionMNIST, fake data.
+
+The reference delegates simple datasets (with ``--download``) to its
+``datasets`` submodule (/root/reference/main.py:44-45; SURVEY.md §2.3).  Here
+they are read from the standard on-disk binary formats into numpy arrays once
+and streamed through tf.data; ``download=True`` fetches the archives when the
+environment has egress and fails with a clear message when it does not.
+
+The ``fake`` backend (no reference analog — SURVEY.md §4 test strategy) is a
+deterministic synthetic dataset for tests and benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+import urllib.request
+from typing import Tuple
+
+import numpy as np
+
+Arrays = Tuple[np.ndarray, np.ndarray]  # images uint8 NHWC, labels int64
+
+
+_URLS = {
+    "cifar10": "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz",
+    "cifar100": "https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz",
+    "mnist": "https://storage.googleapis.com/cvdf-datasets/mnist/",
+    "fashion_mnist":
+        "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/",
+}
+
+
+def _download(url: str, dest: str) -> None:
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    try:
+        urllib.request.urlretrieve(url, dest)  # noqa: S310
+    except Exception as e:
+        raise RuntimeError(
+            f"could not download {url} (no egress?): {e}; place the archive "
+            f"at {dest} manually") from e
+
+
+def load_cifar10(data_dir: str, train: bool, download: bool = False) -> Arrays:
+    root = os.path.join(data_dir, "cifar-10-batches-py")
+    if not os.path.isdir(root):
+        tgz = os.path.join(data_dir, "cifar-10-python.tar.gz")
+        if not os.path.exists(tgz):
+            if not download:
+                raise FileNotFoundError(
+                    f"{root} not found; pass download=True (--download)")
+            _download(_URLS["cifar10"], tgz)
+        with tarfile.open(tgz) as tar:
+            tar.extractall(data_dir)  # noqa: S202
+    names = ([f"data_batch_{i}" for i in range(1, 6)] if train
+             else ["test_batch"])
+    imgs, labels = [], []
+    for n in names:
+        with open(os.path.join(root, n), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        imgs.append(d[b"data"])
+        labels.extend(d[b"labels"])
+    x = np.concatenate(imgs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return np.ascontiguousarray(x), np.asarray(labels, np.int64)
+
+
+def load_cifar100(data_dir: str, train: bool,
+                  download: bool = False) -> Arrays:
+    root = os.path.join(data_dir, "cifar-100-python")
+    if not os.path.isdir(root):
+        tgz = os.path.join(data_dir, "cifar-100-python.tar.gz")
+        if not os.path.exists(tgz):
+            if not download:
+                raise FileNotFoundError(
+                    f"{root} not found; pass download=True (--download)")
+            _download(_URLS["cifar100"], tgz)
+        with tarfile.open(tgz) as tar:
+            tar.extractall(data_dir)  # noqa: S202
+    with open(os.path.join(root, "train" if train else "test"), "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return np.ascontiguousarray(x), np.asarray(d[b"fine_labels"], np.int64)
+
+
+def _load_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    magic = int.from_bytes(data[2:3], "big")
+    ndim = data[3]
+    dims = [int.from_bytes(data[4 + 4 * i:8 + 4 * i], "big")
+            for i in range(ndim)]
+    del magic
+    return np.frombuffer(data, np.uint8, offset=4 + 4 * ndim).reshape(dims)
+
+
+def _load_mnist_like(name: str, data_dir: str, train: bool,
+                     download: bool) -> Arrays:
+    root = os.path.join(data_dir, name)
+    prefix = "train" if train else "t10k"
+    files = [f"{prefix}-images-idx3-ubyte", f"{prefix}-labels-idx1-ubyte"]
+    paths = []
+    for f in files:
+        for cand in (os.path.join(root, f), os.path.join(root, f + ".gz")):
+            if os.path.exists(cand):
+                paths.append(cand)
+                break
+        else:
+            if not download:
+                raise FileNotFoundError(
+                    f"{os.path.join(root, f)}[.gz] not found; pass "
+                    f"download=True (--download)")
+            dest = os.path.join(root, f + ".gz")
+            _download(_URLS[name] + f + ".gz", dest)
+            paths.append(dest)
+    images = _load_idx(paths[0])[..., np.newaxis]          # N,28,28,1
+    images = np.tile(images, (1, 1, 1, 3))                 # grayscale -> RGB
+    return images, _load_idx(paths[1]).astype(np.int64)
+
+
+def load_mnist(data_dir: str, train: bool, download: bool = False) -> Arrays:
+    return _load_mnist_like("mnist", data_dir, train, download)
+
+
+def load_fashion_mnist(data_dir: str, train: bool,
+                       download: bool = False) -> Arrays:
+    return _load_mnist_like("fashion_mnist", data_dir, train, download)
+
+
+def load_fake(num_samples: int = 512, image_size: int = 32,
+              num_classes: int = 10, seed: int = 0) -> Arrays:
+    rng = np.random.RandomState(seed)
+    x = rng.randint(0, 256, size=(num_samples, image_size, image_size, 3),
+                    dtype=np.uint8)
+    y = rng.randint(0, num_classes, size=(num_samples,)).astype(np.int64)
+    return x, y
+
+
+ARRAY_LOADERS = {
+    "cifar10": (load_cifar10, 10),
+    "cifar100": (load_cifar100, 100),
+    "mnist": (load_mnist, 10),
+    "fashion_mnist": (load_fashion_mnist, 10),
+}
