@@ -96,10 +96,11 @@ def main():
         except Exception as e:  # OOM at this batch — try smaller
             msg = str(e)
             if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+                value = baseline = None  # both runs must fit at the SAME bs
                 continue
             raise
-    if value is None:
-        raise RuntimeError("no batch size fit in memory")
+    if value is None or baseline is None:
+        raise RuntimeError("no batch size fit both configurations in memory")
 
     print(json.dumps({
         "metric": f"{arch}_byol_train_images_per_sec_per_chip",

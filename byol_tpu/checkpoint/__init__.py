@@ -1,0 +1,4 @@
+from byol_tpu.checkpoint.checkpointer import CheckpointStore, abstract_like
+from byol_tpu.checkpoint.saver import ModelSaver
+
+__all__ = ["CheckpointStore", "ModelSaver", "abstract_like"]

@@ -1,0 +1,143 @@
+"""Orbax-backed checkpoint store for the full training state.
+
+Replaces the reference's ``helpers.layers.append_save_and_load_fns`` +
+``ModelSaver`` persistence half (contract in SURVEY.md §2.3; call sites
+/root/reference/main.py:749-754).  Coverage mirrors the reference's
+state_dict surface — online params, BN running stats, the EMA target tree
+(the reference carries it as the registered ``mean`` buffer, main.py:146),
+optimizer + schedule state — and additionally persists ``ema_step``, which
+the reference silently resets on resume (Quirk Q6, main.py:143).
+
+TPU-native notes: saves are async (orbax) so the MXU never waits on disk;
+only process 0 writes (rank-0 discipline of main.py:750); on restore the
+tree is placed back onto the caller's shardings via the abstract target.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+_STEP_RE = re.compile(r"^ckpt-(\d+)$")
+_META = "meta.json"
+
+
+def _is_primary() -> bool:
+    return jax.process_index() == 0
+
+
+@dataclasses.dataclass
+class CheckpointStore:
+    """Directory of ``ckpt-<epoch>`` orbax checkpoints + a json metadata file
+    tracking the best epoch/metric."""
+
+    directory: str
+
+    def __post_init__(self) -> None:
+        self.directory = os.path.abspath(self.directory)
+        if _is_primary():
+            os.makedirs(self.directory, exist_ok=True)
+        self._ckptr = ocp.StandardCheckpointer()
+
+    # -- metadata ----------------------------------------------------------
+    def _meta_path(self) -> str:
+        return os.path.join(self.directory, _META)
+
+    def read_meta(self) -> Dict[str, Any]:
+        try:
+            with open(self._meta_path()) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return {}
+
+    def write_meta(self, meta: Dict[str, Any]) -> None:
+        if not _is_primary():
+            return
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=2, sort_keys=True)
+        os.replace(tmp, self._meta_path())
+
+    # -- checkpoints -------------------------------------------------------
+    def _path(self, epoch: int) -> str:
+        return os.path.join(self.directory, f"ckpt-{epoch}")
+
+    def epochs(self) -> Tuple[int, ...]:
+        self._ckptr.wait_until_finished()  # make in-flight saves visible
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return ()
+        out = []
+        for n in names:
+            m = _STEP_RE.match(n)
+            if m:
+                out.append(int(m.group(1)))
+        return tuple(sorted(out))
+
+    def save(self, epoch: int, state: Any, *, metric: Optional[float] = None,
+             is_best: bool = False, keep: int = 2) -> None:
+        """Write ``ckpt-<epoch>`` asynchronously; update metadata; prune old
+        non-best.  The write overlaps the next epoch's compute — we only
+        block here if the PREVIOUS save is still in flight (orbax commits
+        atomically via tmp-dir rename, so readers never see partial state)."""
+        self._ckptr.wait_until_finished()
+        self._prune(keep)  # prune BEFORE scheduling, so we never wait on the
+                           # new write just to list the directory
+        self._ckptr.save(self._path(epoch), state, force=True)
+        meta = self.read_meta()
+        meta["last_epoch"] = epoch
+        if metric is not None:
+            meta.setdefault("history", []).append(
+                {"epoch": epoch, "metric": float(metric)})
+        if is_best:
+            meta["best_epoch"] = epoch
+            if metric is not None:
+                meta["best_metric"] = float(metric)
+        self.write_meta(meta)
+
+    def _prune(self, keep: int) -> None:
+        if not _is_primary():
+            return
+        best = self.read_meta().get("best_epoch")
+        eps = [e for e in self.epochs() if e != best]
+        for e in eps[:-keep] if keep else eps:
+            target = self._path(e)
+            import shutil
+            shutil.rmtree(target, ignore_errors=True)
+
+    def restore(self, abstract_state: Any, epoch: Optional[int] = None,
+                *, best: bool = False) -> Tuple[Any, int]:
+        """Restore ``(state, epoch)``; ``abstract_state`` is a shape/sharding
+        pytree (e.g. from ``jax.eval_shape`` + ``jax.device_put`` layouts) so
+        orbax materializes arrays directly onto the right devices."""
+        if epoch is None:
+            meta = self.read_meta()
+            epoch = (meta.get("best_epoch") if best
+                     else meta.get("last_epoch"))
+            if epoch is None:
+                eps = self.epochs()
+                if not eps:
+                    raise FileNotFoundError(
+                        f"no checkpoints under {self.directory}")
+                epoch = eps[-1]
+        self._ckptr.wait_until_finished()  # flush any in-flight async save
+        state = self._ckptr.restore(self._path(epoch), abstract_state)
+        return state, int(epoch)
+
+    def close(self) -> None:
+        self._ckptr.close()
+
+
+def abstract_like(state: Any) -> Any:
+    """Shape/dtype/sharding skeleton of a live state for :meth:`restore`."""
+    def spec(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+    return jax.tree_util.tree_map(spec, state)

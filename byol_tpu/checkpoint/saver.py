@@ -1,0 +1,90 @@
+"""Best-metric checkpointing + early stopping — the ModelSaver contract.
+
+Reference behavior being reproduced (SURVEY.md §2.3, §3.5;
+/root/reference/main.py:750-769):
+
+- ``ModelSaver(early_stop, rank, burn_in_interval=0.1*epochs,
+  larger_is_better=False, max_early_stop_steps=10)``;
+- called once per epoch with the TEST loss; returns True when training
+  should stop (patience exhausted);
+- burn-in suppresses saves for the first 10% of epochs;
+- ``restore()`` resumes from the best checkpoint and yields the epoch to
+  continue from;
+- rank-0-only writes.
+
+Differences (documented, deliberate): restore returns the full state
+including the EMA tau step counter (Quirk Q6 fix), and early-stop state
+(best metric, stall count) itself survives resume via the store metadata —
+the reference forgets its patience counter on restart.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+from byol_tpu.checkpoint.checkpointer import CheckpointStore, abstract_like
+
+
+class ModelSaver:
+    def __init__(self, directory: str, *, early_stop: bool = False,
+                 burn_in_interval: int = 0, larger_is_better: bool = False,
+                 max_early_stop_steps: int = 10, keep: int = 2) -> None:
+        self.store = CheckpointStore(directory)
+        self.early_stop = early_stop
+        self.burn_in_interval = burn_in_interval
+        self.larger_is_better = larger_is_better
+        self.max_early_stop_steps = max_early_stop_steps
+        self.keep = keep
+        meta = self.store.read_meta()
+        self.best_metric: Optional[float] = meta.get("best_metric")
+        self.stall_count: int = int(meta.get("stall_count", 0))
+
+    def _improved(self, metric: float) -> bool:
+        if self.best_metric is None or math.isnan(self.best_metric):
+            return True
+        if self.larger_is_better:
+            return metric > self.best_metric
+        return metric < self.best_metric
+
+    def __call__(self, metric: float, epoch: int, state: Any) -> bool:
+        """Record this epoch's metric; save if improved (post burn-in);
+        return True when early stopping should trigger (main.py:766-769)."""
+        if epoch < self.burn_in_interval:
+            # Burn-in suppresses saves AND best/patience tracking — otherwise
+            # an unsaved burn-in epoch could hold "best" forever and early
+            # stopping would count stalls against a model we never kept.
+            meta = self.store.read_meta()
+            meta.setdefault("history", []).append(
+                {"epoch": epoch, "metric": float(metric)})
+            self.store.write_meta(meta)
+            return False
+        improved = self._improved(float(metric))
+        if improved:
+            self.best_metric = float(metric)
+            self.stall_count = 0
+        else:
+            self.stall_count += 1
+
+        self.store.save(epoch, state, metric=float(metric),
+                        is_best=improved, keep=self.keep)
+        meta = self.store.read_meta()
+        meta["stall_count"] = self.stall_count
+        meta["best_metric"] = self.best_metric
+        self.store.write_meta(meta)
+
+        return bool(self.early_stop
+                    and self.stall_count >= self.max_early_stop_steps)
+
+    def restore(self, state_template: Any, *, best: bool = True
+                ) -> Tuple[Any, int]:
+        """(state, next_epoch) from the best (default) or last checkpoint.
+        ``state_template`` may be a live state or an abstract skeleton."""
+        abstract = abstract_like(state_template)
+        state, epoch = self.store.restore(abstract, best=best)
+        return state, epoch + 1
+
+    def has_checkpoint(self) -> bool:
+        return bool(self.store.epochs())
+
+    def close(self) -> None:
+        self.store.close()

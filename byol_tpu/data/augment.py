@@ -106,21 +106,32 @@ def gaussian_blur(image: tf.Tensor, kernel_size: int, seed,
     return img[0]
 
 
-def train_augment(image: tf.Tensor, size: int, seed,
-                  color_jitter_strength: float = 1.0) -> tf.Tensor:
-    """One augmented view: image float32 [0,1] HWC -> (size, size, 3)."""
+def post_crop_augment(image: tf.Tensor, size: int, seed,
+                      color_jitter_strength: float = 1.0) -> tf.Tensor:
+    """Everything after the crop: flip, jitter(p=.8), grayscale(p=.2),
+    blur(p=.5), [0,1] clip.  Single source of truth shared by the host-array
+    pipeline and the ImageFolder pipeline (whose crop is fused with JPEG
+    decode).  The blur gate and blur sigma get INDEPENDENT seeds — reusing
+    one seed would make sigma a deterministic function of the gate draw."""
     seeds = _split(seed, 6)
-    image = random_resized_crop(image, size, seeds[0])
-    image = tf.image.stateless_random_flip_left_right(image, seeds[1])
-    image = tf.where(_uniform(seeds[2]) < 0.8,
-                     color_jitter(image, color_jitter_strength, seeds[3]),
+    image = tf.image.stateless_random_flip_left_right(image, seeds[0])
+    image = tf.where(_uniform(seeds[1]) < 0.8,
+                     color_jitter(image, color_jitter_strength, seeds[2]),
                      image)
-    image = random_grayscale(image, seeds[4], p=0.2)
-    image = tf.where(_uniform(seeds[5]) < 0.5,
+    image = random_grayscale(image, seeds[3], p=0.2)
+    image = tf.where(_uniform(seeds[4]) < 0.5,
                      gaussian_blur(image, int(0.1 * size), seeds[5]),
                      image)
     image = tf.reshape(image, (size, size, 3))
     return tf.clip_by_value(image, 0.0, 1.0)
+
+
+def train_augment(image: tf.Tensor, size: int, seed,
+                  color_jitter_strength: float = 1.0) -> tf.Tensor:
+    """One augmented view: image float32 [0,1] HWC -> (size, size, 3)."""
+    s_crop, s_rest = _split(seed, 2)
+    image = random_resized_crop(image, size, s_crop)
+    return post_crop_augment(image, size, s_rest, color_jitter_strength)
 
 
 def test_resize(image: tf.Tensor, size: int) -> tf.Tensor:

@@ -119,14 +119,16 @@ def gaussian_blur(key, image: jnp.ndarray, kernel_size: int,
 def augment_one(key, image: jnp.ndarray, size: int,
                 color_jitter_strength: float = 1.0) -> jnp.ndarray:
     """One view for one image (HWC float32 [0,1]); vmap over the batch."""
-    ks = jax.random.split(key, 6)
+    ks = jax.random.split(key, 7)
     v = random_resized_crop(ks[0], image, size)
     v = jnp.where(_uniform(ks[1]) < 0.5, v[:, ::-1, :], v)
     v = jnp.where(_uniform(ks[2]) < 0.8,
                   color_jitter(ks[3], v, color_jitter_strength), v)
     v = jnp.where(_uniform(ks[4]) < 0.2, jnp.tile(_gray(v), (1, 1, 3)), v)
+    # gate and sigma draw from independent keys (seed reuse would pin sigma
+    # to a deterministic function of the gate draw)
     v = jnp.where(_uniform(ks[5]) < 0.5,
-                  gaussian_blur(ks[5], v, int(0.1 * size)), v)
+                  gaussian_blur(ks[6], v, int(0.1 * size)), v)
     return jnp.clip(v, 0.0, 1.0)
 
 

@@ -127,31 +127,20 @@ def image_folder_loader(cfg: Config, *, host_batch: int,
                 if train:
                     s0 = tf.stack([tf.cast(ex["index"], tf.int32),
                                    tf.constant(seed, tf.int32) + epoch])
+                    # Proper seed splitting (not additive offsets, which
+                    # collide across samples: i's view2 == (i+k)'s view1).
+                    view_seeds = augment._split(s0, 2)
                     views = []
-                    for vi in range(2):
-                        sv = tf.stack([s0[0] + 7919 * vi, s0[1]])
+                    for sv in view_seeds:
+                        s_crop, s_rest = augment._split(sv, 2)
                         crop = tf.cond(
                             _is_jpeg(ex["path"]),
-                            lambda sv=sv: _fused_decode_random_crop(
-                                data, sv, size),
-                            lambda sv=sv: augment.random_resized_crop(
-                                _decode_full(data), size, sv))
-                        # remaining augs after the (possibly fused) crop
-                        seeds = augment._split(
-                            tf.stack([sv[0] + 104729, sv[1]]), 5)
-                        v = tf.image.stateless_random_flip_left_right(
-                            crop, seeds[0])
-                        v = tf.where(
-                            augment._uniform(seeds[1]) < 0.8,
-                            augment.color_jitter(v, cj, seeds[2]), v)
-                        v = augment.random_grayscale(v, seeds[3], p=0.2)
-                        v = tf.where(
-                            augment._uniform(seeds[4]) < 0.5,
-                            augment.gaussian_blur(v, int(0.1 * size),
-                                                  seeds[4]), v)
-                        v = tf.clip_by_value(
-                            tf.reshape(v, (size, size, 3)), 0.0, 1.0)
-                        views.append(v)
+                            lambda s=s_crop: _fused_decode_random_crop(
+                                data, s, size),
+                            lambda s=s_crop: augment.random_resized_crop(
+                                _decode_full(data), size, s))
+                        views.append(augment.post_crop_augment(
+                            crop, size, s_rest, cj))
                     return {"view1": views[0], "view2": views[1],
                             "label": ex["label"]}
                 img = augment.test_resize(_decode_full(data), size)

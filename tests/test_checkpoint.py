@@ -1,0 +1,158 @@
+"""Checkpoint round-trip + ModelSaver early-stop semantics.
+
+The reference could not test its resume path at all (SURVEY.md §4); these
+cover the ModelSaver contract (main.py:750-769) plus the Quirk Q6 fix:
+``ema_step`` must survive a save/restore cycle so the cosine tau schedule
+continues instead of restarting.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from byol_tpu.checkpoint import CheckpointStore, ModelSaver, abstract_like
+from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
+                                  TaskConfig, resolve)
+from byol_tpu.parallel.mesh import MeshSpec, build_mesh, shard_batch_to_mesh
+from byol_tpu.training.build import setup_training
+
+
+def _tiny_setup(mesh, tmp_path, seed=0):
+    cfg = Config(
+        task=TaskConfig(task="fake", batch_size=16, epochs=4,
+                        image_size_override=16),
+        model=ModelConfig(arch="resnet18", head_latent_size=32,
+                          projection_size=16),
+        device=DeviceConfig(num_replicas=8, half=False, seed=seed),
+    )
+    rcfg = resolve(cfg, num_train_samples=64, num_test_samples=16,
+                   output_size=10, input_shape=(16, 16, 3))
+    return rcfg, setup_training(rcfg, mesh, jax.random.PRNGKey(seed))
+
+
+def _batch(mesh, b=16, size=16, seed=0):
+    rng = np.random.RandomState(seed)
+    batch = {
+        "view1": rng.rand(b, size, size, 3).astype(np.float32),
+        "view2": rng.rand(b, size, size, 3).astype(np.float32),
+        "label": rng.randint(0, 10, size=(b,)).astype(np.int32),
+    }
+    return shard_batch_to_mesh(batch, mesh)
+
+
+def test_roundtrip_preserves_full_state(mesh8, tmp_path):
+    _, (net, state, train_step, _, _) = _tiny_setup(mesh8, tmp_path)
+    batch = _batch(mesh8)
+    for _ in range(3):
+        state, _ = train_step(state, batch)
+
+    store = CheckpointStore(str(tmp_path / "ckpt"))
+    store.save(0, state)
+    restored, epoch = store.restore(abstract_like(state))
+    assert epoch == 0
+
+    # Every leaf identical — params, target EMA tree, opt state, counters.
+    flat_a = jax.tree_util.tree_leaves_with_path(state)
+    flat_b = {jax.tree_util.keystr(k): v
+              for k, v in jax.tree_util.tree_leaves_with_path(restored)}
+    assert len(flat_a) == len(flat_b)
+    for k, v in flat_a:
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.asarray(flat_b[jax.tree_util.keystr(k)]),
+                                      err_msg=jax.tree_util.keystr(k))
+    # Quirk Q6 fix: the tau-schedule counter is part of the checkpoint.
+    assert int(restored.ema_step) == 3
+    store.close()
+
+
+def test_resume_continues_training(mesh8, tmp_path):
+    """Restored state must be usable by the jitted step and keep counting."""
+    _, (net, state, train_step, _, _) = _tiny_setup(mesh8, tmp_path)
+    batch = _batch(mesh8)
+    state, _ = train_step(state, batch)
+    store = CheckpointStore(str(tmp_path / "ckpt"))
+    store.save(0, state)
+    restored, _ = store.restore(abstract_like(state))
+    restored, metrics = train_step(restored, batch)
+    assert np.isfinite(float(metrics["loss_mean"]))
+    assert int(restored.step) == 2 and int(restored.ema_step) == 2
+    store.close()
+
+
+def test_model_saver_burn_in_and_best(mesh8, tmp_path):
+    _, (net, state, train_step, _, _) = _tiny_setup(mesh8, tmp_path)
+    saver = ModelSaver(str(tmp_path / "ms"), early_stop=False,
+                       burn_in_interval=2, keep=2)
+    # epochs 0,1 are burn-in: metric tracked, nothing written.
+    assert not saver(1.0, 0, state)
+    assert not saver(0.9, 1, state)
+    assert not saver.has_checkpoint()
+    # epoch 2 improves -> becomes best.
+    assert not saver(0.5, 2, state)
+    assert saver.has_checkpoint()
+    assert saver.store.read_meta()["best_epoch"] == 2
+    # worse epoch still saved as "last" but best pointer stays.
+    assert not saver(0.7, 3, state)
+    meta = saver.store.read_meta()
+    assert meta["best_epoch"] == 2 and meta["last_epoch"] == 3
+    restored, next_epoch = saver.restore(state, best=True)
+    assert next_epoch == 3
+    saver.close()
+
+
+def test_model_saver_early_stop_patience(tmp_path):
+    state = {"w": jnp.arange(4.0)}
+    saver = ModelSaver(str(tmp_path / "es"), early_stop=True,
+                       burn_in_interval=0, max_early_stop_steps=3)
+    assert not saver(1.0, 0, state)
+    assert not saver(0.5, 1, state)     # improvement resets patience
+    assert not saver(0.6, 2, state)     # stall 1
+    assert not saver(0.6, 3, state)     # stall 2
+    assert saver(0.7, 4, state)         # stall 3 -> stop
+    saver.close()
+
+
+def test_burn_in_does_not_hold_best(tmp_path):
+    """A good burn-in metric must not shadow post-burn-in saves: the first
+    epoch after burn-in is always saved as best."""
+    state = {"w": jnp.ones((2,))}
+    saver = ModelSaver(str(tmp_path / "bi"), early_stop=True,
+                       burn_in_interval=2, max_early_stop_steps=5)
+    assert not saver(0.1, 0, state)   # burn-in, better than anything later
+    assert not saver(0.2, 1, state)   # burn-in
+    assert not saver(1.0, 2, state)   # first real epoch -> must become best
+    meta = saver.store.read_meta()
+    assert meta["best_epoch"] == 2 and saver.best_metric == 1.0
+    assert saver.stall_count == 0
+    saver.close()
+
+
+def test_model_saver_larger_is_better(tmp_path):
+    state = {"w": jnp.ones((2,))}
+    saver = ModelSaver(str(tmp_path / "acc"), early_stop=True,
+                       larger_is_better=True, max_early_stop_steps=2)
+    assert not saver(0.1, 0, state)
+    assert not saver(0.3, 1, state)
+    assert not saver(0.2, 2, state)
+    assert saver(0.2, 3, state)
+    assert saver.store.read_meta()["best_epoch"] == 1
+    saver.close()
+
+
+def test_saver_state_survives_restart(tmp_path):
+    """Patience/best metric persist across ModelSaver re-construction
+    (the reference forgets both on restart)."""
+    state = {"w": jnp.ones((2,))}
+    saver = ModelSaver(str(tmp_path / "rs"), early_stop=True,
+                       max_early_stop_steps=3)
+    saver(0.5, 0, state)
+    saver(0.9, 1, state)   # stall 1
+    saver.close()
+    saver2 = ModelSaver(str(tmp_path / "rs"), early_stop=True,
+                        max_early_stop_steps=3)
+    assert saver2.best_metric == 0.5
+    assert saver2.stall_count == 1
+    assert not saver2(0.9, 2, state)  # stall 2
+    assert saver2(0.9, 3, state)      # stall 3 -> stop
+    saver2.close()
