@@ -1,0 +1,138 @@
+"""Unified metric writer — the ``helpers.grapher.Grapher`` contract.
+
+Reference surface (SURVEY.md §2.3, §5.5; call sites
+/root/reference/main.py:452-460,521,542-544,657,779,783):
+
+  Grapher('tensorboard', logdir=...)   # visdom variant: documented delta —
+  .add_scalar(key, value, step)        # visdom is dropped, TB covers it
+  .add_image(key, grid, step)          #  (README.md:95-98 offers both)
+  .add_text(key, text, step)
+  .save(); .close()
+
+Plotting rules reproduced from ``register_plots``/``register_images``
+(main.py:502-544): only keys matching ``*_mean``/``*_scalar`` are plotted as
+scalars, only ``*_img``/``*_imgs`` as images (first <=64 samples, downscaled
+to <=64 px), and only process 0 writes (rank-0 discipline, main.py:452).
+
+Backends: ``tensorboard`` (torch SummaryWriter), ``jsonl`` (newline-JSON for
+machines), ``null``.  All writes are host-side and O(scalar count) — nothing
+here touches device buffers except the explicit image grids.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+_SCALAR_RE = re.compile(r".*(_mean|_scalar)$")
+_IMAGE_RE = re.compile(r".*_imgs?$")
+
+
+def is_scalar_key(key: str) -> bool:
+    return bool(_SCALAR_RE.match(key))
+
+
+def is_image_key(key: str) -> bool:
+    return bool(_IMAGE_RE.match(key))
+
+
+class Grapher:
+    """Facade over one of the writer backends; no-op off process 0."""
+
+    def __init__(self, backend: str = "tensorboard", *, logdir: str = "runs",
+                 run_name: str = "byol", enabled: Optional[bool] = None):
+        if enabled is None:
+            import jax
+            enabled = jax.process_index() == 0
+        self.enabled = enabled
+        self.backend = backend if enabled else "null"
+        self.logdir = os.path.join(logdir, run_name)
+        self._tb = None
+        self._jsonl = None
+        if self.backend == "tensorboard":
+            from torch.utils.tensorboard import SummaryWriter
+            os.makedirs(self.logdir, exist_ok=True)
+            self._tb = SummaryWriter(log_dir=self.logdir)
+        elif self.backend == "jsonl":
+            os.makedirs(self.logdir, exist_ok=True)
+            self._jsonl = open(os.path.join(self.logdir, "metrics.jsonl"),
+                               "a", buffering=1)
+        elif self.backend != "null":
+            raise ValueError(f"unknown grapher backend {self.backend!r}")
+
+    # -- primitive writes --------------------------------------------------
+    def add_scalar(self, key: str, value: float, step: int) -> None:
+        if self._tb is not None:
+            self._tb.add_scalar(key, float(value), step)
+        elif self._jsonl is not None:
+            self._jsonl.write(json.dumps(
+                {"t": time.time(), "step": step, key: float(value)}) + "\n")
+
+    def add_image(self, key: str, grid: np.ndarray, step: int) -> None:
+        """grid: (H, W, C) float [0,1]."""
+        if self._tb is not None:
+            self._tb.add_image(key, np.asarray(grid), step,
+                               dataformats="HWC")
+
+    def add_text(self, key: str, text: str, step: int = 0) -> None:
+        if self._tb is not None:
+            self._tb.add_text(key, text, step)
+        elif self._jsonl is not None:
+            self._jsonl.write(json.dumps(
+                {"t": time.time(), "step": step, key: text}) + "\n")
+
+    def save(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+        if self._jsonl is not None:
+            self._jsonl.flush()
+
+    def close(self) -> None:
+        self.save()
+        if self._tb is not None:
+            self._tb.close()
+        if self._jsonl is not None:
+            self._jsonl.close()
+
+    # -- reference plotting rules (main.py:502-544) ------------------------
+    def register_plots(self, metrics: Dict[str, Any], step: int,
+                       prefix: str = "train") -> None:
+        """Post every ``*_mean``/``*_scalar`` entry as ``<prefix>_<key>``."""
+        for key, value in metrics.items():
+            if is_scalar_key(key):
+                self.add_scalar(f"{prefix}_{key}", float(np.asarray(value)),
+                                step)
+
+    def register_images(self, images: Dict[str, Any], step: int,
+                        prefix: str = "train", max_samples: int = 64,
+                        max_px: int = 64) -> None:
+        """Post ``*_img(s)`` batches as grids: first <=64 samples downscaled
+        to <=64 px (main.py:524-544,649-655)."""
+        for key, batch in images.items():
+            if not is_image_key(key):
+                continue
+            arr = np.asarray(batch)
+            if arr.ndim != 4:
+                continue
+            grid = make_grid(arr[:max_samples], max_px=max_px)
+            self.add_image(f"{prefix}_{key}", grid, step)
+
+
+def make_grid(batch: np.ndarray, max_px: int = 64) -> np.ndarray:
+    """(N, H, W, C) [0,1] -> one square-ish (H', W', C) grid image."""
+    n, h, w, c = batch.shape
+    if max(h, w) > max_px:  # nearest-neighbor downscale, host-side
+        stride = int(np.ceil(max(h, w) / max_px))
+        batch = batch[:, ::stride, ::stride, :]
+        n, h, w, c = batch.shape
+    cols = int(np.ceil(np.sqrt(n)))
+    rows = int(np.ceil(n / cols))
+    grid = np.zeros((rows * h, cols * w, c), batch.dtype)
+    for i in range(n):
+        r, col = divmod(i, cols)
+        grid[r * h:(r + 1) * h, col * w:(col + 1) * w] = batch[i]
+    return np.clip(grid, 0.0, 1.0)

@@ -1,0 +1,86 @@
+"""Epoch metric accumulation + stdout logging + step timing.
+
+Replaces the reference's dm-tree running-sum (main.py:607-608,634-635),
+its per-epoch stdout line (main.py:638-643) and its coarse wall-clock
+timing (main.py:572) with: a pytree accumulator (jax.tree_util — the
+dm-tree TPU-native equivalent, SURVEY.md §2.4), the same log line format,
+and a step timer reporting images/sec/chip — the BASELINE.json headline
+metric the reference never measured (SURVEY.md §5.1).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+class MetricAccumulator:
+    """Running sum of metric pytrees, divided out at epoch end
+    (main.py:607-608,634-635).
+
+    The sum is accumulated with device ops (async dispatch) — no host sync
+    per step, so the trainer's hot loop keeps running ahead of the chip;
+    the only block is the ``result()`` readback at the epoch boundary."""
+
+    def __init__(self) -> None:
+        self._sum: Optional[Any] = None
+        self.count = 0
+
+    def update(self, metrics: Any) -> None:
+        if self._sum is None:
+            self._sum = metrics
+        else:
+            self._sum = jax.tree_util.tree_map(
+                lambda a, b: a + b, self._sum, metrics)
+        self.count += 1
+
+    def result(self) -> Dict[str, np.ndarray]:
+        if self._sum is None:
+            return {}
+        return jax.tree_util.tree_map(
+            lambda s: np.asarray(s) / self.count, self._sum)
+
+
+def epoch_log_line(prefix: str, epoch: int, num_samples: int,
+                   elapsed_s: float, metrics: Dict[str, Any]) -> str:
+    """The reference's one-line epoch summary (main.py:638-643):
+    prefix, epoch, samples, seconds, loss, top1/top5."""
+    def get(k):
+        v = metrics.get(k)
+        return float(np.asarray(v)) if v is not None else float("nan")
+    return (f"{prefix}[Epoch {epoch}][{num_samples} samples]"
+            f"[{elapsed_s:.2f} sec]: loss: {get('loss_mean'):.4f}\t"
+            f"byol: {get('byol_loss_mean'):.4f}\t"
+            f"linear: {get('linear_loss_mean'):.4f}\t"
+            f"top1: {get('top1_mean'):.4f}\ttop5: {get('top5_mean'):.4f}")
+
+
+class StepTimer:
+    """images/sec/chip over a sliding window; host-side, no device syncs
+    (call .tick() after the async dispatch returns, and read .rate() only
+    at epoch boundaries where metrics force a block anyway)."""
+
+    def __init__(self, global_batch: int, n_chips: int, window: int = 50):
+        self.global_batch = global_batch
+        self.n_chips = max(n_chips, 1)
+        self.window = window
+        self._times = []
+
+    def tick(self) -> None:
+        self._times.append(time.perf_counter())
+        if len(self._times) > self.window + 1:
+            self._times.pop(0)
+
+    def reset_window(self) -> None:
+        """Call at epoch start so inter-epoch work (eval, checkpoint, TB
+        flush) never lands inside a tick interval."""
+        self._times = []
+
+    def images_per_sec_per_chip(self) -> float:
+        if len(self._times) < 2:
+            return 0.0
+        dt = self._times[-1] - self._times[0]
+        steps = len(self._times) - 1
+        return self.global_batch * steps / dt / self.n_chips

@@ -1,0 +1,38 @@
+"""Profiling hooks — jax.profiler integration.
+
+The reference has no tracing at all (SURVEY.md §5.1: a single time.time()
+per epoch plus cudnn.benchmark).  TPU-native profiling is first-class here:
+
+- ``trace(logdir)``: capture an XLA/TPU trace viewable in TensorBoard's
+  profile plugin or Perfetto;
+- ``start_server(port)``: on-demand profiling of a live run from another
+  machine (``jax.profiler.start_server`` — the production pod workflow);
+- ``annotate(name)``: named host-side regions (TraceAnnotation) that show up
+  in the timeline alongside device ops.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+def start_server(port: int = 9999):
+    """Expose this process to on-demand profile capture."""
+    return jax.profiler.start_server(port)
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a device+host trace for the enclosed steps."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region in the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
