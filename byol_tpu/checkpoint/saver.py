@@ -38,6 +38,7 @@ class ModelSaver:
         meta = self.store.read_meta()
         self.best_metric: Optional[float] = meta.get("best_metric")
         self.stall_count: int = int(meta.get("stall_count", 0))
+        self.stopped_early: bool = bool(meta.get("stopped_early", False))
 
     def _improved(self, metric: float) -> bool:
         if self.best_metric is None or math.isnan(self.best_metric):
@@ -67,20 +68,37 @@ class ModelSaver:
 
         self.store.save(epoch, state, metric=float(metric),
                         is_best=improved, keep=self.keep)
+        stop = bool(self.early_stop
+                    and self.stall_count >= self.max_early_stop_steps)
         meta = self.store.read_meta()
         meta["stall_count"] = self.stall_count
         meta["best_metric"] = self.best_metric
+        if stop:
+            # Durable terminal marker: a relaunch of an early-stopped run
+            # must not burn patience-worth of epochs re-discovering the stop
+            # (fit() checks .stopped_early before training).
+            meta["stopped_early"] = True
         self.store.write_meta(meta)
-
-        return bool(self.early_stop
-                    and self.stall_count >= self.max_early_stop_steps)
+        return stop
 
     def restore(self, state_template: Any, *, best: bool = True
                 ) -> Tuple[Any, int]:
         """(state, next_epoch) from the best (default) or last checkpoint.
-        ``state_template`` may be a live state or an abstract skeleton."""
+        ``state_template`` may be a live state or an abstract skeleton.
+
+        Restoring from BEST rewinds training to the best epoch, so the
+        patience counter rewinds with it — the rewound epochs are about to
+        be re-trained and re-counted; keeping the old count would double-
+        count them.  (A run that already early-stopped keeps its durable
+        ``stopped_early`` marker — relaunches consult that, not the
+        counter.)"""
         abstract = abstract_like(state_template)
         state, epoch = self.store.restore(abstract, best=best)
+        if best:
+            self.stall_count = 0
+            meta = self.store.read_meta()
+            meta["stall_count"] = 0
+            self.store.write_meta(meta)
         return state, epoch + 1
 
     def has_checkpoint(self) -> bool:

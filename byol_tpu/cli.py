@@ -1,0 +1,198 @@
+"""CLI — the reference's flag surface, resolved into an immutable Config.
+
+Flag names mirror /root/reference/main.py:35-119 (inventory SURVEY.md App B)
+so reference users find the same knobs; parsing happens exactly once inside
+``main()`` (vs the reference's parse-at-import into a mutable module global,
+main.py:119).  TPU-specific additions are grouped at the bottom and
+documented inline.
+
+Semantics preserved: --batch-size is GLOBAL (split across the data axis, the
+main.py:725 analog); --lr is linearly scaled by global_batch/256 for
+sgd/momentum inside the optimizer factory (main.py:333-334); 'lars_' prefix
+composes (main.py:323).  Deltas: --no-cuda/--half become the bf16 policy
+switch; --visdom-url is dropped (tensorboard only, documented in SURVEY.md
+§5.5); --num-replicas defaults to the detected device count.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import List, Optional
+
+from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
+                                  OptimConfig, ParityConfig,
+                                  RegularizerConfig, TaskConfig)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="byol_tpu — TPU-native BYOL (jramapuram/BYOL capability "
+                    "surface)")
+    # Task (main.py:37-53)
+    t = p.add_argument_group("task")
+    t.add_argument("--task", type=str, default="image_folder",
+                   help="image_folder | cifar10 | cifar100 | mnist | "
+                        "fashion_mnist | fake")
+    t.add_argument("--batch-size", type=int, default=4096,
+                   help="GLOBAL batch size")
+    t.add_argument("--epochs", type=int, default=3000)
+    t.add_argument("--download", type=int, default=0)
+    t.add_argument("--image-size-override", type=int, default=224)
+    t.add_argument("--data-dir", type=str, default="./data")
+    t.add_argument("--log-dir", type=str, default="./runs")
+    t.add_argument("--uid", type=str, default="")
+    # Model (main.py:56-70)
+    m = p.add_argument_group("model")
+    m.add_argument("--arch", type=str, default="resnet50")
+    m.add_argument("--representation-size", type=int, default=None,
+                   help="derived from the arch registry unless overridden")
+    m.add_argument("--projection-size", type=int, default=256)
+    m.add_argument("--head-latent-size", type=int, default=4096)
+    m.add_argument("--base-decay", type=float, default=0.996)
+    m.add_argument("--weight-initialization", type=str, default=None)
+    m.add_argument("--model-dir", type=str, default=".models")
+    # Regularizer (main.py:72-78)
+    r = p.add_argument_group("regularizer")
+    r.add_argument("--color-jitter-strength", type=float, default=1.0)
+    r.add_argument("--weight-decay", type=float, default=1e-6)
+    r.add_argument("--polyak-ema", type=float, default=0.0)
+    r.add_argument("--convert-to-sync-bn",
+                   action=argparse.BooleanOptionalAction, default=True)
+    # Optimization (main.py:80-91)
+    o = p.add_argument_group("optimization")
+    o.add_argument("--clip", type=float, default=0.0)
+    o.add_argument("--lr", type=float, default=0.2)
+    o.add_argument("--lr-update-schedule", type=str, default="cosine",
+                   choices=("fixed", "cosine"))
+    o.add_argument("--warmup", type=int, default=10, help="warmup epochs")
+    o.add_argument("--optimizer", type=str, default="lars_momentum")
+    o.add_argument("--early-stop", action="store_true")
+    # Device / debug / distributed (main.py:99-117)
+    d = p.add_argument_group("device")
+    d.add_argument("--num-replicas", type=int, default=0,
+                   help="data-axis size; 0 = all detected devices")
+    d.add_argument("--workers-per-replica", type=int, default=2)
+    d.add_argument("--distributed-master", type=str, default="",
+                   help="JAX coordinator address (multi-host)")
+    d.add_argument("--distributed-rank", type=int, default=0)
+    d.add_argument("--distributed-port", type=int, default=29300)
+    d.add_argument("--debug-step", action="store_true",
+                   help="single minibatch per train/eval pass (main.py:110)")
+    d.add_argument("--seed", type=int, default=1234)
+    d.add_argument("--half", action="store_true", default=True,
+                   help="bf16 compute policy (apex O2 analog)")
+    d.add_argument("--no-half", dest="half", action="store_false")
+    # TPU-native extensions
+    x = p.add_argument_group("tpu")
+    x.add_argument("--model-parallel", type=int, default=1,
+                   help="tensor-parallel axis size")
+    x.add_argument("--sequence-parallel", type=int, default=1,
+                   help="sequence/context-parallel axis size (ViT)")
+    x.add_argument("--fuse-views", action="store_true",
+                   help="one fused encoder call for both views (perf; "
+                        "changes BN batch statistics vs the reference)")
+    x.add_argument("--remat", action="store_true",
+                   help="checkpoint the encoder (HBM for FLOPs)")
+    x.add_argument("--attn-impl", type=str, default="dense",
+                   choices=("dense", "flash", "ring"),
+                   help="ViT attention backend")
+    x.add_argument("--pooling", type=str, default="cls",
+                   choices=("cls", "gap"), help="ViT feature pooling")
+    x.add_argument("--data-backend", type=str, default="tf",
+                   choices=("tf", "native"),
+                   help="host pipeline: tf.data or the native C++ kernel "
+                        "(DALI-equivalent)")
+    x.add_argument("--loss-norm-mode", type=str, default="paper",
+                   choices=("paper", "reference"), help="Quirk Q2 switch")
+    x.add_argument("--ema-init-mode", type=str, default="copy",
+                   choices=("copy", "reference"), help="Quirk Q1 switch")
+    x.add_argument("--schedule-granularity", type=str, default="step",
+                   choices=("step", "epoch"), help="Quirk Q5 switch")
+    x.add_argument("--profile-port", type=int, default=0,
+                   help="start jax.profiler server on this port (0=off)")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    import jax
+    n_rep = args.num_replicas or jax.device_count() // (
+        args.model_parallel * args.sequence_parallel)
+    return Config(
+        task=TaskConfig(
+            task=args.task, data_dir=args.data_dir,
+            batch_size=args.batch_size, epochs=args.epochs,
+            download=bool(args.download),
+            image_size_override=args.image_size_override,
+            log_dir=args.log_dir, uid=args.uid,
+            data_backend=args.data_backend),
+        model=ModelConfig(
+            arch=args.arch,
+            representation_size=(args.representation_size
+                                 if args.representation_size else 2048),
+            projection_size=args.projection_size,
+            head_latent_size=args.head_latent_size,
+            base_decay=args.base_decay,
+            weight_initialization=args.weight_initialization,
+            model_dir=args.model_dir,
+            fuse_views=args.fuse_views, remat=args.remat,
+            attn_impl=args.attn_impl, pooling=args.pooling),
+        regularizer=RegularizerConfig(
+            color_jitter_strength=args.color_jitter_strength,
+            weight_decay=args.weight_decay,
+            polyak_ema=args.polyak_ema,
+            convert_to_sync_bn=args.convert_to_sync_bn),
+        optim=OptimConfig(
+            clip=args.clip, lr=args.lr,
+            lr_update_schedule=args.lr_update_schedule,
+            warmup=args.warmup, optimizer=args.optimizer,
+            early_stop=args.early_stop),
+        device=DeviceConfig(
+            num_replicas=n_rep,
+            workers_per_replica=args.workers_per_replica,
+            distributed_master=args.distributed_master,
+            distributed_rank=args.distributed_rank,
+            distributed_port=args.distributed_port,
+            debug_step=args.debug_step, seed=args.seed, half=args.half,
+            model_parallel=args.model_parallel,
+            sequence_parallel=args.sequence_parallel),
+        parity=ParityConfig(
+            loss_norm_mode=args.loss_norm_mode,
+            ema_init_mode=args.ema_init_mode,
+            schedule_granularity=args.schedule_granularity),
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    # Multi-host rendezvous MUST happen before anything initializes the local
+    # XLA backend (config_from_args queries jax.device_count()).  The
+    # reference had the same ordering constraint around init_process_group
+    # (main.py:717-722).
+    if args.distributed_master:
+        from byol_tpu.parallel.mesh import initialize_distributed
+        master = args.distributed_master
+        if ":" not in master:
+            master = f"{master}:{args.distributed_port}"
+        # On TPU pods JAX auto-detects process identity; --num-replicas +
+        # --distributed-rank pin it explicitly elsewhere (the reference's
+        # one-process-per-node topology, main.py:807-810).
+        explicit = args.num_replicas > 0
+        initialize_distributed(
+            master,
+            num_processes=args.num_replicas if explicit else None,
+            process_id=args.distributed_rank if explicit else None)
+    cfg = config_from_args(args)
+    print(cfg.to_json())  # full-config dump at startup (main.py:743)
+    if args.profile_port:
+        from byol_tpu.observability import profiling
+        profiling.start_server(args.profile_port)
+    from byol_tpu.training.trainer import fit
+    result = fit(cfg)
+    print(f"done: epoch {result.epoch}, test loss "
+          f"{result.test_metrics.get('loss_mean', float('nan')):.4f}, "
+          f"{result.images_per_sec_per_chip:.1f} images/sec/chip")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

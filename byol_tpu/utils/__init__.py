@@ -1,0 +1,59 @@
+"""Framework utilities — the ``helpers.utils`` contract (SURVEY.md §2.3).
+
+Call-site-for-call-site equivalents of the reference's helpers submodule
+surface: run-metadata introspection (AWS instance id main.py:128-130, SLURM
+id main.py:775-777), parameter counting (main.py:447-449), and the no-op
+context manager (main.py:584).  ``number_of_gpus``/launch topology
+(main.py:800-801) has no analog — JAX owns device enumeration.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def get_slurm_id() -> Optional[str]:
+    """SLURM job identity for run metadata (main.py:775-777)."""
+    job = os.environ.get("SLURM_JOB_ID")
+    task = os.environ.get("SLURM_ARRAY_TASK_ID")
+    if job and task:
+        return f"{job}_{task}"
+    return job
+
+
+def get_aws_instance_id(timeout: float = 0.25) -> Optional[str]:
+    """EC2 instance id via the metadata endpoint (main.py:128-130); returns
+    None quickly off-cloud."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(  # noqa: S310
+                "http://169.254.169.254/latest/meta-data/instance-id",
+                timeout=timeout) as r:
+            return r.read().decode()
+    except Exception:
+        return None
+
+
+def get_tpu_env() -> dict:
+    """TPU-native run metadata (the AWS/SLURM analog for pods)."""
+    keys = ("TPU_WORKER_ID", "TPU_ACCELERATOR_TYPE", "TPU_PROCESS_BOUNDS",
+            "MEGASCALE_SLICE_ID")
+    return {k: os.environ[k] for k in keys if k in os.environ}
+
+
+def number_of_parameters(params: Any) -> int:
+    """Total parameter count of a pytree (main.py:447-449)."""
+    return sum(int(np.prod(p.shape))
+               for p in jax.tree_util.tree_leaves(params)
+               if hasattr(p, "shape"))
+
+
+@contextlib.contextmanager
+def dummy_context():
+    """No-op context manager (the train-mode branch of the reference's
+    no_grad switch, main.py:584 — vestigial in JAX, kept for API parity)."""
+    yield

@@ -1,0 +1,11 @@
+#!/usr/bin/env python
+"""Launcher — ``python train.py <flags>`` like the reference's main.py.
+
+One process per TPU host (the mp.spawn/one-proc-per-node topology switch of
+/root/reference/main.py:786-814 collapses under JAX: device enumeration and
+cross-host collectives are owned by the runtime; multi-host rendezvous is
+``--distributed-master``)."""
+from byol_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())
