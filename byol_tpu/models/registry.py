@@ -79,9 +79,11 @@ def _register_vit() -> None:
     }.items():
         def factory(dtype=jnp.float32, small_inputs=False, _w=width, _d=depth,
                     _h=heads, _p=patch, **kw):
-            del small_inputs, kw  # BN-free path: no resnet knobs apply
+            del small_inputs  # BN-free path: no resnet stem knobs apply
+            # kw passes through ViT-specific knobs: attn_impl ('dense' |
+            # 'flash' | 'ring'), remat, pooling.
             return vit_lib.ViT(width=_w, depth=_d, num_heads=_h, patch_size=_p,
-                               dtype=dtype)
+                               dtype=dtype, **kw)
         register(name, BackboneSpec(factory=factory, feature_dim=width,
                                     has_batchnorm=False))
 

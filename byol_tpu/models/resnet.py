@@ -98,6 +98,9 @@ class ResNet(nn.Module):
     bn_epsilon: float = 1e-5
     small_inputs: bool = False           # CIFAR stem: 3x3/1, no max-pool
     zero_init_residual: bool = True      # False = torchvision/reference init
+    remat: bool = False                  # jax.checkpoint each residual block
+                                         # (recompute activations in backward:
+                                         # HBM for FLOPs)
 
     @property
     def feature_dim(self) -> int:
@@ -122,13 +125,14 @@ class ResNet(nn.Module):
         x = nn.relu(x)
         if not self.small_inputs:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(filters=self.width * 2 ** i,
-                                   strides=strides, conv=conv, norm=norm,
-                                   zero_init_last_bn=self.zero_init_residual,
-                                   name=f"stage{i + 1}_block{j + 1}")(x)
+                x = block_cls(filters=self.width * 2 ** i,
+                              strides=strides, conv=conv, norm=norm,
+                              zero_init_last_bn=self.zero_init_residual,
+                              name=f"stage{i + 1}_block{j + 1}")(x)
         x = jnp.mean(x, axis=(1, 2))     # global average pool
         return x.astype(self.dtype)
 
@@ -146,7 +150,8 @@ BASIC = {"resnet18", "resnet34"}
 
 def make_resnet(name: str, *, dtype=jnp.float32, width_multiplier: int = 1,
                 small_inputs: bool = False,
-                zero_init_residual: bool = True) -> ResNet:
+                zero_init_residual: bool = True,
+                remat: bool = False) -> ResNet:
     base = name.replace("w2", "")
     if base not in STAGE_SIZES:
         raise ValueError(f"unknown resnet arch {name!r}; "
@@ -157,4 +162,5 @@ def make_resnet(name: str, *, dtype=jnp.float32, width_multiplier: int = 1,
     return ResNet(stage_sizes=STAGE_SIZES[base], block_cls=block,
                   width=64 * width_multiplier, dtype=dtype,
                   small_inputs=small_inputs,
-                  zero_init_residual=zero_init_residual)
+                  zero_init_residual=zero_init_residual,
+                  remat=remat)

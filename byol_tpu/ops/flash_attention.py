@@ -1,0 +1,114 @@
+"""Pallas flash attention — blockwise online-softmax, no S x S in HBM.
+
+TPU-native long-sequence attention for the ViT path (``attn_impl='flash'``).
+The reference has no attention anywhere (ResNet-only hot path,
+/root/reference/main.py:190-193); this kernel exists because long-context is
+first-class in the rebuild and XLA's dense softmax attention materializes
+the (S, S) score matrix in HBM for large S.
+
+Kernel design (see /opt/skills/guides/pallas_guide.md):
+- grid over (batch*heads, S/block_q); each program holds one q tile in VMEM
+  and streams K/V tiles with ``pl.ds``, maintaining the online-softmax
+  running max ``m``, normalizer ``l`` and fp32 accumulator as
+  ``lax.fori_loop`` carries;
+- the two matmuls per tile hit the MXU with
+  ``preferred_element_type=float32`` (bf16-safe statistics);
+- HBM traffic is O(S*D) per program instead of O(S^2);
+- non-block-aligned sequences are zero-padded; padded KEY positions are
+  masked to -inf inside the kernel, padded QUERY rows are sliced away.
+
+``interpret=True`` (default off-TPU) runs the same kernel under the Pallas
+interpreter so CPU tests exercise identical code paths.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() well-defined
+                 # when an entire tile is masked (all-padding tail block)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int,
+                  seq_len: int):
+    q = q_ref[0]                                   # (block_q, d)
+    padded_k, d = k_ref.shape[1], k_ref.shape[2]
+    n_k = padded_k // block_k
+    block_q = q.shape[0]
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :]      # (block_k, d)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (block_q, block_k)
+        # mask key positions beyond the true sequence length
+        kpos = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(kpos < seq_len, s, NEG_INF)
+        m_curr = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m, m_curr)
+        p = jnp.exp(s - m_next)                           # fp32
+        alpha = jnp.exp(m - m_next)                       # (block_q, 1)
+        l_next = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (block_q, d)
+        return m_next, l_next, acc * alpha + pv
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, mult):
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_q", "block_k", "interpret"))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """(B, H, S, D) x3 -> (B, H, S, D); same contract as dense_attention."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, s, d = q.shape
+    scale = d ** -0.5
+
+    q = _pad_to(q, 2, block_q)
+    k = _pad_to(k, 2, block_k)
+    v = _pad_to(v, 2, block_k)
+    s_pad_q, s_pad_k = q.shape[2], k.shape[2]
+
+    qr = q.reshape(b * h, s_pad_q, d)
+    kr = k.reshape(b * h, s_pad_k, d)
+    vr = v.reshape(b * h, s_pad_k, d)
+
+    kernel = functools.partial(_flash_kernel, scale=scale, block_k=block_k,
+                               seq_len=s)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s_pad_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s_pad_k, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s_pad_k, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_pad_q, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s_pad_q, d)[:, :, :s, :]

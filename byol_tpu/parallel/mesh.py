@@ -36,16 +36,23 @@ SEQUENCE_AXIS = "sequence"
 AXIS_NAMES = (DATA_AXIS, SEQUENCE_AXIS, MODEL_AXIS)
 
 
+_distributed_initialized = False
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> None:
     """Multi-host rendezvous; the ``--distributed-master``/``--distributed-rank``
-    analog (reference main.py:105-109,794-797).  No-op for single process."""
-    if coordinator_address:
+    analog (reference main.py:105-109,794-797).  No-op for single process and
+    idempotent, so the CLI can initialize early (before anything touches
+    jax.devices()) and ``fit()`` can call it again safely."""
+    global _distributed_initialized
+    if coordinator_address and not _distributed_initialized:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id)
+        _distributed_initialized = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,75 @@
+"""Parameter partitioning rules — tensor parallelism over the ``model`` axis.
+
+The reference is data-parallel only (SURVEY.md §2.2: DDP full replicas); the
+mesh here carries a ``model`` axis so tensor parallelism can be enabled
+without re-plumbing.  These rules implement Megatron-style TP for the
+projector/predictor MLP heads (the widest matmuls outside the backbone:
+representation -> 4096 hidden -> 256, main.py:194-205):
+
+  dense1 kernel (in, hidden)   -> P(None, 'model')   column-parallel
+  dense1 bias / BN params      -> P('model')         follow the hidden dim
+  dense2 kernel (hidden, out)  -> P('model', None)   row-parallel
+  dense2 bias                  -> P()                replicated
+
+Column-then-row keeps the activation sharded through the hidden dim with ONE
+all-reduce at dense2's output — inserted automatically by GSPMD because the
+contraction crosses the sharded axis.  Everything else (backbone, probe,
+counters) is replicated.
+
+The matcher walks tree PATHS, so the same rules shard the online params, the
+EMA target tree, the Polyak tree, and every params-shaped subtree inside the
+optax state (momentum buffers carry the same path suffixes).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from byol_tpu.parallel.mesh import MODEL_AXIS
+
+_TP_MODULES = ("projector", "predictor")
+
+
+def _path_names(path) -> tuple:
+    names = []
+    for entry in path:
+        name = getattr(entry, "key", None)
+        if name is None:
+            name = getattr(entry, "name", None)
+        if isinstance(name, str):
+            names.append(name)
+    return tuple(names)
+
+
+def leaf_pspec(path, leaf) -> P:
+    """PartitionSpec for one state leaf under the TP rules."""
+    names = _path_names(path)
+    ndim = getattr(leaf, "ndim", 0)
+    if not any(m in names for m in _TP_MODULES):
+        return P()
+    if "dense1" in names:
+        if ndim == 2:
+            return P(None, MODEL_AXIS)
+        if ndim == 1:
+            return P(MODEL_AXIS)
+    if "bn" in names and ndim == 1:
+        return P(MODEL_AXIS)      # scale/bias/mean/var follow the hidden dim
+    if "dense2" in names and ndim == 2:
+        return P(MODEL_AXIS, None)
+    return P()
+
+
+def state_shardings(state: Any, mesh: Mesh) -> Any:
+    """NamedSharding tree for a TrainState (or any params-bearing pytree).
+
+    With a size-1 model axis this degenerates to fully-replicated — the
+    data-parallel layout the reference uses (full DDP replicas).
+    """
+    if mesh.shape.get(MODEL_AXIS, 1) == 1:
+        return jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()), state)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, leaf_pspec(path, leaf)),
+        state)

@@ -34,8 +34,13 @@ def build_net(rcfg: ResolvedConfig) -> BYOLNet:
     policy = get_policy(cfg.device.half)
     small = rcfg.input_shape[0] <= 64    # CIFAR-style stem
     from byol_tpu.models.registry import get_spec
-    extra = ({"zero_init_residual": cfg.parity.zero_init_residual}
-             if get_spec(cfg.model.arch).has_batchnorm else {})
+    if get_spec(cfg.model.arch).has_batchnorm:
+        extra = {"zero_init_residual": cfg.parity.zero_init_residual,
+                 "remat": cfg.model.remat}
+    else:  # ViT-family knobs
+        extra = {"remat": cfg.model.remat,
+                 "attn_impl": cfg.model.attn_impl,
+                 "pooling": cfg.model.pooling}
     return build_byol_net(
         cfg.model.arch,
         num_classes=rcfg.output_size,
@@ -46,9 +51,13 @@ def build_net(rcfg: ResolvedConfig) -> BYOLNet:
         **extra)
 
 
-def init_variables(net: BYOLNet, rcfg: ResolvedConfig, rng: jax.Array):
+def init_variables(net: BYOLNet, rcfg: ResolvedConfig, rng: jax.Array,
+                   *, batch: int = 2):
+    """``batch`` must be divisible by the mesh's data axis when the model
+    contains shard_map ops (ring attention) — setup_training sizes it to
+    the mesh."""
     h, w, c = rcfg.input_shape
-    dummy = jnp.zeros((2, h, w, c), jnp.float32)
+    dummy = jnp.zeros((batch, h, w, c), jnp.float32)
     return net.init({"params": rng}, dummy, train=True, method="warmup")
 
 
@@ -94,7 +103,16 @@ def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array
     scfg = step_config(rcfg)
 
     with mesh:
-        variables = init_variables(net, rcfg, rng)
+        variables = init_variables(
+            net, rcfg, rng, batch=max(2, mesh.shape[DATA_AXIS]))
+        if cfg.model.weight_initialization:
+            # --weight-initialization scheme re-draw (main.py:436 analog)
+            from byol_tpu.models.init import apply_weight_init
+            init_rng = jax.random.fold_in(rng, 1)
+            variables = dict(variables)
+            variables["params"] = apply_weight_init(
+                variables["params"], init_rng,
+                cfg.model.weight_initialization)
         state = create_train_state(
             variables, tx,
             ema_init_mode=cfg.parity.ema_init_mode,
@@ -102,17 +120,32 @@ def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array
 
     replicated = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(DATA_AXIS))
-    state = jax.device_put(state, replicated)
+    # State layout: replicated for pure DP (the reference's full-replica
+    # model); TP rules shard the MLP-head params/EMA/opt-state over the
+    # 'model' axis when it is >1 (parallel/partitioning.py).
+    from byol_tpu.parallel.partitioning import state_shardings
+    state_sh = state_shardings(state, mesh)
+    state = jax.device_put(state, state_sh)
 
-    # Prefix-pytree shardings: whole state replicated, all batch leaves
-    # sharded on the data axis.
     train_step = jax.jit(
         make_train_step(net, tx, scfg, policy),
-        in_shardings=(replicated, batch_sh),
-        out_shardings=(replicated, replicated),
+        in_shardings=(state_sh, batch_sh),
+        out_shardings=(state_sh, replicated),
         donate_argnums=(0,))
     eval_step = jax.jit(
         make_eval_step(net, scfg, policy),
-        in_shardings=(replicated, batch_sh),
+        in_shardings=(state_sh, batch_sh),
         out_shardings=replicated)
-    return net, state, train_step, eval_step, schedule
+
+    def _with_mesh(fn):
+        # keep the mesh in thread-local scope at call (=trace) time so
+        # mesh-aware ops inside the step (ring attention's shard_map) can
+        # resolve the ambient mesh; steady-state calls just hit the jit
+        # cache and the context costs nothing.
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with mesh:
+                return fn(*args, **kwargs)
+        return wrapped
+
+    return net, state, _with_mesh(train_step), _with_mesh(eval_step), schedule
