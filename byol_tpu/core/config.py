@@ -32,6 +32,9 @@ class TaskConfig:
     image_size_override: Optional[int] = 224  # ref main.py:46-47
     log_dir: str = "./runs"
     uid: str = ""                       # run identity (ref main.py:52-53)
+    # Host pipeline backend for array datasets: 'tf' (tf.data) or 'native'
+    # (multithreaded C++ kernel, the DALI-equivalent — data/native/).
+    data_backend: str = "tf"
 
 
 @_frozen
@@ -55,6 +58,10 @@ class ModelConfig:
                                         # so off by default; turn on for perf.
     remat: bool = False                 # jax.checkpoint the encoder to trade
                                         # FLOPs for HBM.
+    attn_impl: str = "dense"            # ViT attention backend: 'dense'
+                                        # (XLA), 'flash' (Pallas), 'ring'
+                                        # (sequence-parallel over the mesh).
+    pooling: str = "cls"                # ViT feature pooling: 'cls' | 'gap'.
 
 
 @_frozen

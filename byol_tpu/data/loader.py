@@ -116,6 +116,44 @@ def _array_pipeline(images: np.ndarray, labels: np.ndarray, *,
     return make
 
 
+def _native_pipeline(images: np.ndarray, labels: np.ndarray, *,
+                     batch_size: int, image_size: int, train: bool,
+                     color_jitter_strength: float, seed: int, shuffle: bool,
+                     num_threads: int) -> Callable[[int], Iterator[Batch]]:
+    """C++ host pipeline (data/native_aug.py) — the DALI-equivalent backend.
+
+    Same iterator contract as the tf.data path: per-epoch reshuffle from
+    (seed, epoch), two augmented views in train, resize-only eval,
+    drop-remainder train batching."""
+    from byol_tpu.data import native_aug
+
+    labels = labels.astype(np.int32)
+
+    def make(epoch: int) -> Iterator[Batch]:
+        idx = np.arange(len(labels))
+        if shuffle:
+            np.random.RandomState(seed + epoch).shuffle(idx)
+        n = len(idx)
+        end = n - (n % batch_size) if train else n
+        for lo in range(0, end, batch_size):
+            take = idx[lo:lo + batch_size]
+            imgs = images[take]
+            if train:
+                v1, v2 = native_aug.augment_two_views(
+                    imgs, image_size,
+                    color_jitter_strength=color_jitter_strength,
+                    # epoch folded into the stream seed = set_all_epochs
+                    seed=seed + 1_000_003 * epoch, index_base=int(lo),
+                    num_threads=num_threads)
+            else:
+                v1 = native_aug.resize_batch(imgs, image_size,
+                                             num_threads=num_threads)
+                v2 = v1
+            yield {"view1": v1, "view2": v2, "label": labels[take]}
+
+    return make
+
+
 def get_loader(cfg: Config, *, num_fake_samples: int = 512,
                shard_eval: bool = False) -> LoaderBundle:
     """Dispatch on ``cfg.task.task``; see module docstring for the contract.
@@ -159,11 +197,29 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
         x_te, y_te = _shard_arrays(x_te, y_te, index, count)
 
     cj = cfg.regularizer.color_jitter_strength
+    backend = cfg.task.data_backend
+    if backend == "native":
+        from byol_tpu.data import native_aug
+        if not native_aug.available():
+            # documented graceful degradation: no toolchain/binary -> tf.data
+            print("byol_tpu: native data backend unavailable "
+                  "(no g++/.so); falling back to tf.data")
+            backend = "tf"
+    if backend == "native":
+        import functools
+        pipeline = functools.partial(
+            _native_pipeline,
+            num_threads=max(cfg.device.workers_per_replica, 1))
+    elif backend == "tf":
+        pipeline = _array_pipeline
+    else:
+        raise ValueError(
+            f"unknown data_backend {cfg.task.data_backend!r} ('tf'|'native')")
     return LoaderBundle(
-        make_train_iter=_array_pipeline(
+        make_train_iter=pipeline(
             x_trs, y_trs, batch_size=host_batch, image_size=size, train=True,
             color_jitter_strength=cj, seed=cfg.device.seed, shuffle=True),
-        make_test_iter=_array_pipeline(
+        make_test_iter=pipeline(
             x_te, y_te, batch_size=host_batch, image_size=size, train=False,
             color_jitter_strength=cj, seed=cfg.device.seed, shuffle=False),
         input_shape=(size, size, 3),

@@ -1,0 +1,120 @@
+"""ctypes binding + lazy build for the native C++ augmentation pipeline.
+
+The reference's native data path is NVIDIA DALI (C++/CUDA, SURVEY.md §2.4);
+ours is ``data/native/image_pipeline.cpp`` — a multithreaded C++ kernel
+producing two augmented float32 views per uint8 image with the canonical
+augmentation spec.  This module compiles it on first use (g++, ~2s, cached
+next to the source) and exposes numpy-in/numpy-out entry points; when no
+toolchain or binary is available the loader silently stays on the tf.data
+backend, so the native path is strictly opt-in acceleration
+(``data_backend='native'``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SRC = os.path.join(_SRC_DIR, "image_pipeline.cpp")
+_LIB = os.path.join(_SRC_DIR, "libbyol_aug.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+           "-o", _LIB, _SRC]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"native build failed: {proc.stderr[-2000:]}")
+
+
+def load(rebuild: bool = False) -> ctypes.CDLL:
+    """Load (building if needed) the native library; raises on failure."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None and not rebuild:
+            return _lib
+        if _build_error and not rebuild:
+            raise RuntimeError(_build_error)
+        try:
+            if rebuild or not os.path.exists(_LIB) or (
+                    os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            f32p = ctypes.POINTER(ctypes.c_float)
+            lib.byol_augment_two_views.argtypes = [
+                u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                f32p, f32p, ctypes.c_int, ctypes.c_float,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int]
+            lib.byol_augment_two_views.restype = None
+            lib.byol_resize_batch.argtypes = [
+                u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                f32p, ctypes.c_int, ctypes.c_int]
+            lib.byol_resize_batch.restype = None
+            _lib = lib
+            _build_error = None
+            return lib
+        except Exception as e:  # toolchain missing, load failure, ...
+            _build_error = str(e)
+            raise
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except Exception:
+        return False
+
+
+def _check_batch(images: np.ndarray) -> np.ndarray:
+    if images.ndim != 4 or images.shape[-1] != 3:
+        raise ValueError(f"expected (N, H, W, 3) uint8, got {images.shape}")
+    return np.ascontiguousarray(images, dtype=np.uint8)
+
+
+def augment_two_views(images: np.ndarray, size: int, *,
+                      color_jitter_strength: float = 1.0, seed: int = 0,
+                      index_base: int = 0,
+                      num_threads: Optional[int] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """(N, H, W, 3) uint8 -> two (N, size, size, 3) float32 views in [0,1]."""
+    lib = load()
+    images = _check_batch(images)
+    n, h, w, _ = images.shape
+    if num_threads is None:
+        num_threads = min(os.cpu_count() or 1, 16)
+    v1 = np.empty((n, size, size, 3), np.float32)
+    v2 = np.empty((n, size, size, 3), np.float32)
+    lib.byol_augment_two_views(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n, h, w,
+        v1.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        v2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        size, float(color_jitter_strength), seed & (2**64 - 1),
+        index_base & (2**64 - 1), num_threads)
+    return v1, v2
+
+
+def resize_batch(images: np.ndarray, size: int, *,
+                 num_threads: Optional[int] = None) -> np.ndarray:
+    """Resize-only eval transform (reference main.py:398, Quirk Q3)."""
+    lib = load()
+    images = _check_batch(images)
+    n, h, w, _ = images.shape
+    if num_threads is None:
+        num_threads = min(os.cpu_count() or 1, 16)
+    out = np.empty((n, size, size, 3), np.float32)
+    lib.byol_resize_batch(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n, h, w,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size,
+        num_threads)
+    return out
