@@ -140,6 +140,25 @@ def test_model_saver_larger_is_better(tmp_path):
     saver.close()
 
 
+def test_early_stop_marker_is_durable(tmp_path):
+    """Once a run early-stops, a relaunched ModelSaver must report it so
+    fit() can short-circuit instead of re-burning patience epochs."""
+    state = {"w": jnp.ones((2,))}
+    saver = ModelSaver(str(tmp_path / "es2"), early_stop=True,
+                       max_early_stop_steps=2)
+    saver(0.5, 0, state)
+    saver(0.9, 1, state)
+    assert saver(0.9, 2, state)  # stop fires
+    saver.close()
+    relaunched = ModelSaver(str(tmp_path / "es2"), early_stop=True,
+                            max_early_stop_steps=2)
+    assert relaunched.stopped_early
+    # and the best checkpoint is still restorable
+    restored, next_epoch = relaunched.restore(state, best=True)
+    assert next_epoch == 1
+    relaunched.close()
+
+
 def test_saver_state_survives_restart(tmp_path):
     """Patience/best metric persist across ModelSaver re-construction
     (the reference forgets both on restart)."""

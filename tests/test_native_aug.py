@@ -1,0 +1,90 @@
+"""Native C++ augmentation pipeline (the DALI-equivalent backend)."""
+import numpy as np
+import pytest
+
+from byol_tpu.data import native_aug
+
+pytestmark = pytest.mark.skipif(not native_aug.available(),
+                                reason="no C++ toolchain")
+
+
+def _imgs(n=8, h=40, w=48, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (n, h, w, 3), dtype=np.uint8)
+
+
+def test_two_views_shape_range_and_decorrelation():
+    v1, v2 = native_aug.augment_two_views(_imgs(), 32, seed=1)
+    assert v1.shape == v2.shape == (8, 32, 32, 3)
+    assert v1.dtype == np.float32
+    # the [0,1] input contract the trainer enforces (main.py:486-490)
+    for v in (v1, v2):
+        assert v.min() >= 0.0 and v.max() <= 1.0
+    # two views of the same image must differ (independent streams)
+    assert not np.allclose(v1, v2)
+
+
+def test_determinism_and_seed_sensitivity():
+    imgs = _imgs()
+    a1, a2 = native_aug.augment_two_views(imgs, 32, seed=7, index_base=100)
+    b1, b2 = native_aug.augment_two_views(imgs, 32, seed=7, index_base=100)
+    np.testing.assert_array_equal(a1, b1)
+    np.testing.assert_array_equal(a2, b2)
+    c1, _ = native_aug.augment_two_views(imgs, 32, seed=8, index_base=100)
+    assert not np.allclose(a1, c1)
+
+
+def test_multithreaded_matches_single_thread():
+    imgs = _imgs(n=16)
+    s1, s2 = native_aug.augment_two_views(imgs, 24, seed=3, num_threads=1)
+    m1, m2 = native_aug.augment_two_views(imgs, 24, seed=3, num_threads=8)
+    np.testing.assert_array_equal(s1, m1)
+    np.testing.assert_array_equal(s2, m2)
+
+
+def test_resize_batch_matches_uint8_identity():
+    """Resize to the source size must reproduce the image (up to 1/255)."""
+    imgs = _imgs(n=2, h=16, w=16)
+    out = native_aug.resize_batch(imgs, 16)
+    np.testing.assert_allclose(out, imgs.astype(np.float32) / 255.0,
+                               atol=1e-6)
+
+
+def test_loader_native_backend_end_to_end():
+    from byol_tpu.core.config import Config, DeviceConfig, TaskConfig
+    from byol_tpu.data.loader import get_loader
+
+    cfg = Config(task=TaskConfig(task="fake", batch_size=16,
+                                 image_size_override=16,
+                                 data_backend="native"),
+                 device=DeviceConfig(num_replicas=8, seed=0))
+    loader = get_loader(cfg, num_fake_samples=48)
+    batches = list(loader.train_loader)
+    assert len(batches) == 3  # 48 // 16, drop remainder
+    b = batches[0]
+    assert b["view1"].shape == (16, 16, 16, 3)
+    assert b["label"].dtype == np.int32
+    assert 0.0 <= b["view1"].min() and b["view1"].max() <= 1.0
+    # epoch reseed changes the draw (set_all_epochs contract, main.py:760)
+    loader.set_all_epochs(1)
+    b1 = next(iter(loader.train_loader))
+    assert not np.array_equal(b["view1"], b1["view1"])
+    # eval: resize-only, both view slots identical
+    eb = next(iter(loader.test_loader))
+    np.testing.assert_array_equal(eb["view1"], eb["view2"])
+
+
+def test_augment_distribution_sanity():
+    """Statistical smoke: over many samples, ~50% flips/blurs, ~20%
+    grayscale.  Catches gate/draw seed-coupling regressions (the bug class
+    fixed in the TF path) without pinning exact streams."""
+    imgs = np.tile(
+        np.linspace(0, 255, 32 * 32 * 3, dtype=np.uint8).reshape(
+            1, 32, 32, 3), (400, 1, 1, 1))
+    v1, _ = native_aug.augment_two_views(imgs, 32, seed=11,
+                                         color_jitter_strength=0.0)
+    # with cj strength 0 the pipeline is crop+flip+gray+blur; count grayscale
+    # outputs: all three channels equal everywhere
+    gray = np.all(np.abs(v1[..., 0] - v1[..., 1]) < 1e-6, axis=(1, 2))
+    frac_gray = gray.mean()
+    assert 0.1 < frac_gray < 0.32, frac_gray

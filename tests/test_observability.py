@@ -1,0 +1,78 @@
+"""Grapher contract, metric accumulation, epoch log format."""
+import json
+import os
+
+import numpy as np
+
+from byol_tpu.observability import (Grapher, MetricAccumulator, StepTimer,
+                                    epoch_log_line, make_grid)
+from byol_tpu.observability.grapher import is_image_key, is_scalar_key
+
+
+def test_scalar_image_key_filters():
+    # main.py:502-544: only *_mean/*_scalar plot; only *_img(s) image.
+    assert is_scalar_key("loss_mean") and is_scalar_key("lr_scalar")
+    assert not is_scalar_key("loss") and not is_scalar_key("mean_loss")
+    assert is_image_key("aug1_img") and is_image_key("aug_imgs")
+    assert not is_image_key("image_grid")
+
+
+def test_jsonl_backend_roundtrip(tmp_path):
+    g = Grapher("jsonl", logdir=str(tmp_path), run_name="r", enabled=True)
+    g.register_plots({"loss_mean": 1.5, "ignored": 2.0}, step=3,
+                     prefix="train")
+    g.add_text("config", "{}", 0)
+    g.close()
+    lines = [json.loads(l) for l in
+             open(tmp_path / "r" / "metrics.jsonl")]
+    assert any(l.get("train_loss_mean") == 1.5 for l in lines)
+    assert not any("train_ignored" in l for l in lines)
+
+
+def test_tensorboard_backend_writes(tmp_path):
+    g = Grapher("tensorboard", logdir=str(tmp_path), run_name="tb",
+                enabled=True)
+    g.register_plots({"loss_mean": 0.5}, step=0)
+    g.register_images({"aug1_imgs": np.random.rand(4, 8, 8, 3)}, step=0)
+    g.close()
+    files = os.listdir(tmp_path / "tb")
+    assert any("tfevents" in f for f in files)
+
+
+def test_disabled_grapher_is_noop(tmp_path):
+    g = Grapher("tensorboard", logdir=str(tmp_path), run_name="off",
+                enabled=False)
+    g.register_plots({"loss_mean": 0.5}, step=0)
+    g.close()
+    assert not os.path.exists(tmp_path / "off")
+
+
+def test_make_grid_shape_and_downscale():
+    grid = make_grid(np.random.rand(10, 128, 128, 3), max_px=64)
+    rows, cols = 3, 4  # ceil(sqrt(10))=4 cols, ceil(10/4)=3 rows
+    assert grid.shape == (rows * 64, cols * 64, 3)
+    assert grid.min() >= 0.0 and grid.max() <= 1.0
+
+
+def test_metric_accumulator_epoch_average():
+    acc = MetricAccumulator()
+    acc.update({"loss_mean": np.float32(2.0), "top1_mean": np.float32(0.5)})
+    acc.update({"loss_mean": np.float32(4.0), "top1_mean": np.float32(1.0)})
+    out = acc.result()
+    assert out["loss_mean"] == 3.0 and out["top1_mean"] == 0.75
+    assert acc.count == 2
+
+
+def test_epoch_log_line_format():
+    line = epoch_log_line("train", 3, 1024, 12.5,
+                          {"loss_mean": 1.0, "byol_loss_mean": 0.5,
+                           "linear_loss_mean": 0.5, "top1_mean": 0.25,
+                           "top5_mean": 0.75})
+    assert "train[Epoch 3][1024 samples][12.50 sec]" in line
+    assert "top1: 0.2500" in line
+
+
+def test_step_timer_rate():
+    t = StepTimer(global_batch=100, n_chips=4)
+    t._times = [0.0, 1.0, 2.0]  # 2 steps over 2s
+    assert abs(t.images_per_sec_per_chip() - 100 * 2 / 2.0 / 4) < 1e-9

@@ -1,0 +1,133 @@
+"""Tensor-parallel sharding rules + dp x tp / dp x sp train steps on the
+virtual CPU mesh — the multi-strategy coverage the reference never had
+(SURVEY.md §2.2: DP was its only strategy)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
+                                  TaskConfig, resolve)
+from byol_tpu.parallel.mesh import (MODEL_AXIS, MeshSpec, build_mesh,
+                                    shard_batch_to_mesh)
+from byol_tpu.parallel.partitioning import leaf_pspec, state_shardings
+from byol_tpu.training.build import setup_training
+
+
+def _setup(mesh, *, data, model=1, sequence=1, arch="resnet18", image=16,
+           **model_kw):
+    cfg = Config(
+        task=TaskConfig(task="fake", batch_size=2 * data, epochs=2,
+                        image_size_override=image),
+        model=ModelConfig(arch=arch, head_latent_size=64, projection_size=32,
+                          **model_kw),
+        device=DeviceConfig(num_replicas=data, half=False, seed=0,
+                            model_parallel=model,
+                            sequence_parallel=sequence),
+    )
+    rcfg = resolve(cfg, num_train_samples=8 * data, num_test_samples=2 * data,
+                   output_size=10, input_shape=(image, image, 3))
+    return cfg, setup_training(rcfg, mesh, jax.random.PRNGKey(0))
+
+
+def _batch(mesh, b, image=16, seed=0):
+    r = np.random.RandomState(seed)
+    return shard_batch_to_mesh(
+        {"view1": r.rand(b, image, image, 3).astype(np.float32),
+         "view2": r.rand(b, image, image, 3).astype(np.float32),
+         "label": r.randint(0, 10, (b,)).astype(np.int32)}, mesh)
+
+
+def test_leaf_pspec_rules():
+    class Key:  # stand-in for jax tree path entries
+        def __init__(self, key):
+            self.key = key
+
+    kernel2d = np.zeros((8, 4))
+    bias1d = np.zeros((4,))
+    path = (Key("params"), Key("projector"), Key("dense1"), Key("kernel"))
+    assert leaf_pspec(path, kernel2d) == P(None, MODEL_AXIS)
+    path = (Key("params"), Key("predictor"), Key("dense1"), Key("bias"))
+    assert leaf_pspec(path, bias1d) == P(MODEL_AXIS)
+    path = (Key("params"), Key("projector"), Key("dense2"), Key("kernel"))
+    assert leaf_pspec(path, kernel2d) == P(MODEL_AXIS, None)
+    path = (Key("params"), Key("projector"), Key("dense2"), Key("bias"))
+    assert leaf_pspec(path, bias1d) == P()
+    path = (Key("params"), Key("backbone"), Key("stem_conv"), Key("kernel"))
+    assert leaf_pspec(path, kernel2d) == P()
+    # BN inside a TP'd head follows the hidden dim
+    path = (Key("batch_stats"), Key("predictor"), Key("bn"), Key("mean"))
+    assert leaf_pspec(path, bias1d) == P(MODEL_AXIS)
+
+
+def test_dp_mesh_is_fully_replicated(mesh8):
+    shardings = state_shardings({"a": np.zeros((4, 4))}, mesh8)
+    assert shardings["a"].spec == P()
+
+
+def test_tp_train_step_matches_dp():
+    """Same seed, same batch: a dp x tp run must produce the same loss as
+    pure dp (TP is a layout choice, not a numerics choice)."""
+    devices = jax.devices()[:8]
+    mesh_dp = build_mesh(MeshSpec(data=8), devices)
+    mesh_tp = build_mesh(MeshSpec(data=4, model=2), devices)
+
+    _, (_, state_dp, step_dp, _, _) = _setup(mesh_dp, data=8)
+    _, (_, state_tp, step_tp, _, _) = _setup(mesh_tp, data=4, model=2)
+
+    # the TP layout must actually shard the head params
+    spec = state_tp.params["projector"]["dense1"]["kernel"].sharding.spec
+    assert MODEL_AXIS in spec
+    # and the optimizer state inherits the same layout by path
+    flat = jax.tree_util.tree_leaves_with_path(state_tp.opt_state)
+    tp_opt = [jax.tree_util.keystr(p) for p, leaf in flat
+              if getattr(leaf, "ndim", 0) == 2
+              and MODEL_AXIS in str(leaf.sharding.spec)]
+    assert tp_opt, "no optimizer-state leaf is TP-sharded"
+
+    b_dp = _batch(mesh_dp, 16)
+    b_tp = _batch(mesh_tp, 8)
+    state_dp, m_dp = step_dp(state_dp, b_dp)
+    state_tp, m_tp = step_tp(state_tp, b_tp)
+    # batches differ (16 vs 8) so losses differ; what must agree is that
+    # both run and stay finite, and that identical inputs agree:
+    assert np.isfinite(float(m_dp["loss_mean"]))
+    assert np.isfinite(float(m_tp["loss_mean"]))
+
+
+def test_tp_same_batch_matches_dp_numerics():
+    """Identical global batch through dp-8 and dp4 x tp2: same loss."""
+    devices = jax.devices()[:8]
+    mesh_dp = build_mesh(MeshSpec(data=8), devices)
+    mesh_tp = build_mesh(MeshSpec(data=4, model=2), devices)
+    _, (_, state_dp, step_dp, _, _) = _setup(mesh_dp, data=8)
+    _, (_, state_tp, step_tp, _, _) = _setup(mesh_tp, data=4, model=2)
+    # resolve() divides the global batch by num_replicas for step math only;
+    # the actual arrays are global — feed the same 8-sample batch to both.
+    b = _batch(mesh_dp, 8, seed=3)
+    b2 = _batch(mesh_tp, 8, seed=3)
+    _, m_dp = step_dp(state_dp, b)
+    _, m_tp = step_tp(state_tp, b2)
+    np.testing.assert_allclose(float(m_dp["loss_mean"]),
+                               float(m_tp["loss_mean"]), rtol=2e-4)
+
+
+def test_sp_ring_vit_train_step(mesh_dp_sp):
+    """Full BYOL train step with ring attention over the sequence axis."""
+    from byol_tpu.models import registry
+    if "vit_sp_test" not in registry.available():
+        from byol_tpu.models import vit as vit_lib
+        registry.register("vit_sp_test", registry.BackboneSpec(
+            factory=lambda dtype=jnp.float32, small_inputs=False, **kw:
+                vit_lib.ViT(width=32, depth=1, num_heads=4, patch_size=8,
+                            dtype=dtype, **kw),
+            feature_dim=32, has_batchnorm=False))
+    _, (_, state, train_step, eval_step, _) = _setup(
+        mesh_dp_sp, data=4, sequence=2, arch="vit_sp_test", image=32,
+        attn_impl="ring", pooling="gap")
+    b = _batch(mesh_dp_sp, 8, image=32)
+    state, metrics = train_step(state, b)
+    assert np.isfinite(float(metrics["loss_mean"]))
+    ev = eval_step(state, b)
+    assert np.isfinite(float(ev["loss_mean"]))

@@ -1,0 +1,124 @@
+"""End-to-end trainer integration: fit() on fake data over the 8-device CPU
+mesh — the smoke test the reference could only approximate with
+``--debug-step`` on live hardware (SURVEY.md §4)."""
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from byol_tpu.cli import build_parser, config_from_args
+from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
+                                  OptimConfig, TaskConfig)
+from byol_tpu.observability import Grapher
+from byol_tpu.training.trainer import fit
+
+
+def _tiny_cfg(tmp_path, **over):
+    base = dict(
+        task=TaskConfig(task="fake", batch_size=16, epochs=2,
+                        image_size_override=16,
+                        log_dir=str(tmp_path / "runs")),
+        model=ModelConfig(arch="resnet18", head_latent_size=32,
+                          projection_size=16,
+                          model_dir=str(tmp_path / "models")),
+        optim=OptimConfig(lr=0.05, warmup=1, optimizer="lars_momentum"),
+        device=DeviceConfig(num_replicas=8, half=False, seed=7),
+    )
+    base.update(over)
+    return Config(**base)
+
+
+def _tiny_loader(cfg):
+    # 32 train samples @ bs16 = 2 steps/epoch: the CI box has ONE core for
+    # all 8 virtual devices, so every step costs seconds — keep counts tiny.
+    from byol_tpu.data.loader import get_loader
+    return get_loader(cfg, num_fake_samples=32)
+
+
+def test_fit_end_to_end(tmp_path):
+    cfg = _tiny_cfg(tmp_path)
+    grapher = Grapher("jsonl", logdir=str(tmp_path / "runs"), run_name="t",
+                      enabled=True)
+    result = fit(cfg, loader=_tiny_loader(cfg), grapher=grapher,
+                 verbose=False)
+    assert result.epoch == 1 and not result.stopped_early
+    assert np.isfinite(result.train_metrics["loss_mean"])
+    assert np.isfinite(result.test_metrics["loss_mean"])
+    assert set(result.test_metrics) >= {"loss_mean", "byol_loss_mean",
+                                        "linear_loss_mean", "top1_mean",
+                                        "top5_mean"}
+    # the step counter must equal epochs * steps_per_epoch.
+    assert int(result.state.step) == 2 * (32 // 16)
+    # scalars reached the grapher, with train_/test_ prefixes
+    lines = [json.loads(l) for l in
+             open(tmp_path / "runs" / "t" / "metrics.jsonl")]
+    keys = set()
+    for l in lines:
+        keys.update(l)
+    assert "train_loss_mean" in keys and "test_loss_mean" in keys
+    assert "lr_scalar" in keys
+    # checkpoint written under model_dir/<run-name>
+    runs = os.listdir(tmp_path / "models")
+    assert len(runs) == 1
+    assert any(d.startswith("ckpt-") for d in
+               os.listdir(tmp_path / "models" / runs[0]))
+
+
+def test_fit_resume_continues_epochs(tmp_path):
+    # debug_step keeps each epoch to one minibatch so the test exercises the
+    # resume path, not the hot loop.
+    cfg = _tiny_cfg(tmp_path,
+                    device=DeviceConfig(num_replicas=8, half=False, seed=7,
+                                        debug_step=True))
+    r1 = fit(cfg, loader=_tiny_loader(cfg), verbose=False)
+    # Same config -> same run dir -> a second fit() restores the best
+    # checkpoint and continues with the restored step counters.
+    r2 = fit(cfg, loader=_tiny_loader(cfg), verbose=False)
+    assert int(r2.state.step) >= int(r1.state.step)
+
+
+def test_fit_debug_step(tmp_path):
+    cfg = _tiny_cfg(tmp_path,
+                    device=DeviceConfig(num_replicas=8, half=False, seed=7,
+                                        debug_step=True))
+    result = fit(cfg, loader=_tiny_loader(cfg), verbose=False)
+    assert int(result.state.step) == 2  # one minibatch per epoch x 2 epochs
+
+
+def test_fit_rejects_out_of_range_inputs(tmp_path):
+    from byol_tpu.data.loader import LoaderBundle
+
+    def bad_iter(epoch):
+        yield {"view1": np.full((16, 16, 16, 3), 1.5, np.float32),
+               "view2": np.zeros((16, 16, 16, 3), np.float32),
+               "label": np.zeros((16,), np.int32)}
+
+    loader = LoaderBundle(make_train_iter=bad_iter, make_test_iter=bad_iter,
+                          input_shape=(16, 16, 3), num_train_samples=16,
+                          num_test_samples=16, output_size=10)
+    cfg = _tiny_cfg(tmp_path)
+    with pytest.raises(ValueError, match=r"\[0,1\]"):
+        fit(cfg, loader=loader, verbose=False)
+
+
+def test_cli_parser_reference_surface(tmp_path):
+    """Every reference flag (SURVEY App B) parses; defaults match."""
+    args = build_parser().parse_args([])
+    assert args.batch_size == 4096 and args.epochs == 3000
+    assert args.lr == 0.2 and args.optimizer == "lars_momentum"
+    assert args.arch == "resnet50" and args.base_decay == 0.996
+    assert args.warmup == 10 and args.weight_decay == 1e-6
+
+    args = build_parser().parse_args([
+        "--task", "fake", "--batch-size", "16", "--epochs", "1",
+        "--arch", "resnet18", "--debug-step", "--no-half",
+        "--loss-norm-mode", "reference", "--ema-init-mode", "reference",
+        "--schedule-granularity", "epoch"])
+    cfg = config_from_args(args)
+    assert cfg.task.batch_size == 16 and cfg.device.debug_step
+    assert not cfg.device.half
+    assert cfg.parity.loss_norm_mode == "reference"
+    assert cfg.parity.ema_init_mode == "reference"
+    assert cfg.parity.schedule_granularity == "epoch"

@@ -1,0 +1,62 @@
+"""Weight-initialization registry (--weight-initialization contract)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byol_tpu.models.init import apply_weight_init, available
+
+
+def _params():
+    return {
+        "backbone": {"stem_conv": {"kernel": jnp.ones((3, 3, 3, 8))},
+                     "stem_bn": {"scale": jnp.ones((8,)),
+                                 "bias": jnp.zeros((8,))}},
+        "probe": {"classifier": {"kernel": jnp.ones((8, 10)),
+                                 "bias": jnp.zeros((10,))}},
+    }
+
+
+def test_none_is_identity():
+    p = _params()
+    out = apply_weight_init(p, jax.random.PRNGKey(0), None)
+    assert out is p
+
+
+def test_redraws_kernels_leaves_rest():
+    p = _params()
+    out = apply_weight_init(p, jax.random.PRNGKey(0), "xavier_uniform")
+    # kernels changed
+    assert not np.allclose(out["backbone"]["stem_conv"]["kernel"],
+                           p["backbone"]["stem_conv"]["kernel"])
+    assert not np.allclose(out["probe"]["classifier"]["kernel"],
+                           p["probe"]["classifier"]["kernel"])
+    # BN scale/bias and biases untouched
+    np.testing.assert_array_equal(out["backbone"]["stem_bn"]["scale"],
+                                  p["backbone"]["stem_bn"]["scale"])
+    np.testing.assert_array_equal(out["probe"]["classifier"]["bias"],
+                                  p["probe"]["classifier"]["bias"])
+
+
+def test_deterministic_per_key():
+    p = _params()
+    a = apply_weight_init(p, jax.random.PRNGKey(1), "kaiming_normal")
+    b = apply_weight_init(p, jax.random.PRNGKey(1), "kaiming_normal")
+    c = apply_weight_init(p, jax.random.PRNGKey(2), "kaiming_normal")
+    np.testing.assert_array_equal(a["probe"]["classifier"]["kernel"],
+                                  b["probe"]["classifier"]["kernel"])
+    assert not np.allclose(a["probe"]["classifier"]["kernel"],
+                           c["probe"]["classifier"]["kernel"])
+
+
+def test_every_registered_scheme_runs():
+    p = _params()
+    for name in available():
+        out = apply_weight_init(p, jax.random.PRNGKey(0), name)
+        k = np.asarray(out["backbone"]["stem_conv"]["kernel"])
+        assert np.all(np.isfinite(k)), name
+
+
+def test_unknown_scheme_raises():
+    with pytest.raises(ValueError, match="unknown weight initialization"):
+        apply_weight_init(_params(), jax.random.PRNGKey(0), "bogus")
