@@ -79,6 +79,14 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--debug-step", action="store_true",
                    help="single minibatch per train/eval pass (main.py:110)")
     d.add_argument("--seed", type=int, default=1234)
+    d.add_argument("--check-numerics", action="store_true",
+                   help="fail fast on NaN/inf (jax_debug_nans)")
+    d.add_argument("--fault-at-step", type=int, default=0,
+                   help="fault injection: kill the process at step N "
+                        "(tests checkpoint/resume)")
+    d.add_argument("--shard-eval", action="store_true",
+                   help="shard the test set across hosts (reference "
+                        "evaluates it fully on every rank, Quirk Q9)")
     d.add_argument("--half", action="store_true", default=True,
                    help="bf16 compute policy (apex O2 analog)")
     d.add_argument("--no-half", dest="half", action="store_false")
@@ -153,6 +161,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
             distributed_rank=args.distributed_rank,
             distributed_port=args.distributed_port,
             debug_step=args.debug_step, seed=args.seed, half=args.half,
+            check_numerics=args.check_numerics,
+            fault_at_step=args.fault_at_step,
+            shard_eval=args.shard_eval,
             model_parallel=args.model_parallel,
             sequence_parallel=args.sequence_parallel),
         parity=ParityConfig(

@@ -99,6 +99,13 @@ class DeviceConfig:
     distributed_port: int = 29300
     debug_step: bool = False            # single-minibatch smoke (ref main.py:110)
     seed: int = 1234
+    # Aux hygiene (SURVEY.md §5.2/§5.3 — absent in the reference):
+    check_numerics: bool = False        # jax_debug_nans: fail fast on NaN/inf
+    fault_at_step: int = 0              # >0: kill the process at step N to
+                                        # exercise preemption/resume paths
+    shard_eval: bool = False            # shard the test set across hosts
+                                        # (Quirk Q9: reference evaluates the
+                                        # full test set on every rank)
     half: bool = True                   # bf16 compute policy (apex-O2 analog,
                                         # ref main.py:122-124; no loss scaling
                                         # needed on TPU bf16)

@@ -163,6 +163,14 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
     main.py:38-39).
     """
     task = cfg.task.task
+    # Reference task-name aliases (main.py:38-39; README.md:93): the DALI
+    # variant maps to the native C++ backend for array tasks and to the
+    # fused-decode tf.data path for image trees — ONE canonical augmentation
+    # spec either way (Quirk Q4 deliberately not reproduced).
+    if task == "multi_augment_image_folder":
+        task = "image_folder"
+    elif task == "dali_multi_augment_image_folder":
+        task = "image_folder"
     index, count = _process_info()
     if cfg.task.batch_size % count != 0:
         raise ValueError(f"global batch {cfg.task.batch_size} not divisible "

@@ -68,6 +68,9 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     """Train per the config; returns final state + last epoch metrics."""
     if cfg.device.distributed_master:
         initialize_distributed(cfg.device.distributed_master)
+    if cfg.device.check_numerics:
+        # NaN/inf fail-fast (the §5.2 hygiene the reference lacks)
+        jax.config.update("jax_debug_nans", True)
 
     n_devices = jax.device_count()
     tp_sp = cfg.device.model_parallel * cfg.device.sequence_parallel
@@ -85,7 +88,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                                model=cfg.device.model_parallel))
 
     if loader is None:
-        loader = get_loader(cfg)
+        loader = get_loader(cfg, shard_eval=cfg.device.shard_eval)
     rcfg = resolve(cfg, num_train_samples=loader.num_train_samples,
                    num_test_samples=loader.num_test_samples,
                    output_size=loader.output_size,
@@ -168,6 +171,13 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
             state, metrics = train_step(state, dev_batch)
             timer.tick()
             acc.update(metrics)  # device-side running sum; no host sync
+            if cfg.device.fault_at_step and \
+                    int(state.step) == cfg.device.fault_at_step:
+                # fault injection (§5.3): die mid-epoch like a preempted pod
+                # worker; a relaunch must resume from the last checkpoint.
+                raise SystemExit(
+                    f"fault injected at step {int(state.step)} "
+                    f"(--fault-at-step)")
             if cfg.device.debug_step:  # single-minibatch smoke (main.py:630)
                 break
         train_metrics = {k: float(v) for k, v in acc.result().items()}

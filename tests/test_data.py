@@ -107,6 +107,18 @@ class TestImageFolder:
         with pytest.raises(FileNotFoundError):
             get_loader(cfg)
 
+    def test_reference_task_name_aliases(self, tree):
+        # the reference's task names (main.py:38-39, README.md:93) keep
+        # working; the DALI variant maps to the same canonical spec
+        for alias in ("multi_augment_image_folder",
+                      "dali_multi_augment_image_folder"):
+            cfg = Config(
+                task=TaskConfig(task=alias, data_dir=str(tree),
+                                batch_size=4, image_size_override=32),
+                device=DeviceConfig(num_replicas=1, seed=0))
+            bundle = get_loader(cfg)
+            assert bundle.output_size == 2
+
 
 class TestDeviceAugment:
     def test_two_view_batch(self):

@@ -87,6 +87,27 @@ def test_fit_debug_step(tmp_path):
     assert int(result.state.step) == 2  # one minibatch per epoch x 2 epochs
 
 
+def test_fault_injection_then_resume(tmp_path):
+    """--fault-at-step kills mid-run; a relaunch resumes from the last
+    checkpoint and completes (the preemption drill of SURVEY.md §5.3 that
+    the reference could only do by killing real jobs)."""
+    cfg = _tiny_cfg(
+        tmp_path,
+        task=TaskConfig(task="fake", batch_size=16, epochs=3,
+                        image_size_override=16,
+                        log_dir=str(tmp_path / "runs"), uid="fault"),
+        device=DeviceConfig(num_replicas=8, half=False, seed=7,
+                            debug_step=True, fault_at_step=2))
+    with pytest.raises(SystemExit, match="fault injected at step 2"):
+        fit(cfg, loader=_tiny_loader(cfg), verbose=False)
+    # relaunch without the fault: resumes and completes the 3 epochs
+    cfg2 = cfg.replace(device=dataclasses.replace(cfg.device,
+                                                  fault_at_step=0))
+    result = fit(cfg2, loader=_tiny_loader(cfg2), verbose=False)
+    assert result.epoch == 2
+    assert np.isfinite(result.test_metrics["loss_mean"])
+
+
 def test_fit_rejects_out_of_range_inputs(tmp_path):
     from byol_tpu.data.loader import LoaderBundle
 
