@@ -1,6 +1,6 @@
 """Benchmark: BYOL training-step throughput, images/sec/chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "mfu"}.
 
 The reference publishes no throughput numbers (BASELINE.md), so the baseline
 here is measured in-process: a reference-faithful configuration (fp32, four
@@ -9,19 +9,78 @@ separate encoder forwards with per-view BN batches — the semantics of
 TPU-first default (bf16 compute, fused two-view forward).  ``vs_baseline`` is
 the speedup of the TPU-first path over that faithful translation on the same
 chip, i.e. what the TPU-native redesign buys.
+
+Robustness contract (hard-learned — this script burned two benchmark rounds):
+- ANY failure while building/measuring one batch-ladder candidate is treated
+  as "that batch did not fit" (logged to stderr with the real traceback) and
+  the ladder steps down.  Compile-time OOM on this platform surfaces as
+  ``JaxRuntimeError: INTERNAL: ... tpu_compile_helper subprocess exit code
+  1`` — not RESOURCE_EXHAUSTED — so string-matching specific OOM spellings
+  is a losing game.
+- Every measured result is flushed to ``bench_partial.json`` IMMEDIATELY, so
+  a later failure (e.g. the fp32 baseline config) can never zero out an
+  already-measured number.
+- If the baseline config fails at every ladder rung, the primary result is
+  still printed with ``vs_baseline: null`` rather than crashing.
+
+MFU: analytic model FLOPs / measured step time / chip peak.  FLOPs count
+multiply-add as 2 (the same convention as the quoted chip peaks).  Per
+sample: 2 online forwards + 2 target forwards + backward (~2x the online
+forwards) = 8 encoder-forward-equivalents; head MLP/probe FLOPs are <1% of
+the RN50 trunk at 224px and are ignored.
+
+Usage:
+  python bench.py            # the two headline configs -> one JSON line
+  python bench.py --sweep    # batch x remat x fuse grid -> bench_sweep.json
 """
 from __future__ import annotations
 
 import json
+import sys
 import time
+import traceback
 
 import jax
-import jax.numpy as jnp
 import numpy as np
+
+# fwd GMACs per image (multiply-accumulates; FLOPs = 2x). torchvision-style
+# counts for the conv trunk; heads ignored (sub-1% at these shapes).
+_GMACS = {
+    ("resnet50", 224): 4.089,
+    ("resnet50", 96): 0.76,
+    ("resnet18", 224): 1.814,
+    ("resnet18", 32): 0.557,   # CIFAR stem (3x3 s1, no maxpool)
+}
+
+# bf16 peak TFLOP/s per chip, keyed by substring of device_kind.
+_PEAK_TFLOPS = (
+    ("v5 lite", 197.0),   # v5e
+    ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v6", 918.0),        # Trillium
+    ("v4", 275.0),
+    ("v3", 123.0),
+)
+
+
+def _chip_peak_tflops() -> float | None:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in _PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def _flops_per_sample(arch: str, image_size: int) -> float | None:
+    gmacs = _GMACS.get((arch, image_size))
+    if gmacs is None:
+        return None
+    # 2 online + 2 target fwds + bwd (2x online's 2 fwds) = 8 fwd-images.
+    return 8.0 * gmacs * 2.0 * 1e9
 
 
 def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
-           fuse_views: bool, ema_update_mode: str):
+           fuse_views: bool, ema_update_mode: str, remat: bool = False):
     from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
                                       ParityConfig, TaskConfig, resolve)
     from byol_tpu.parallel.mesh import MeshSpec, build_mesh, shard_batch_to_mesh
@@ -32,7 +91,7 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
     cfg = Config(
         task=TaskConfig(task="fake", batch_size=batch_size * n_dev, epochs=100,
                         image_size_override=image_size),
-        model=ModelConfig(arch=arch, fuse_views=fuse_views),
+        model=ModelConfig(arch=arch, fuse_views=fuse_views, remat=remat),
         device=DeviceConfig(num_replicas=n_dev, half=half, seed=0),
         parity=ParityConfig(ema_update_mode=ema_update_mode),
     )
@@ -54,12 +113,12 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
 
 
 def _throughput(batch_size: int, image_size: int, arch: str, *, half: bool,
-                fuse_views: bool, ema_update_mode: str,
+                fuse_views: bool, ema_update_mode: str, remat: bool = False,
                 steps: int = 20) -> float:
     """Images/sec/chip for one configuration (global images / sec / n_dev)."""
     state, train_step, batch = _build(
         batch_size, image_size, arch, half=half, fuse_views=fuse_views,
-        ema_update_mode=ema_update_mode)
+        ema_update_mode=ema_update_mode, remat=remat)
     # warmup: compile + 2 steady steps.  NB: sync via a scalar READBACK, not
     # block_until_ready — on tunneled platforms (axon) block_until_ready
     # returns at dispatch-ack and wildly overstates throughput; a D2H read
@@ -77,6 +136,24 @@ def _throughput(batch_size: int, image_size: int, arch: str, *, half: bool,
     return global_batch * steps / dt / n_dev
 
 
+_PARTIAL_PATH = "bench_partial.json"
+_partial: dict = {"results": []}
+
+
+def _flush_partial():
+    try:
+        with open(_PARTIAL_PATH, "w") as f:
+            json.dump(_partial, f, indent=2)
+            f.write("\n")
+    except OSError as e:  # read-only fs must not kill the measurement
+        print(f"bench: could not write {_PARTIAL_PATH}: {e}", file=sys.stderr)
+
+
+def _record(name: str, **fields):
+    _partial["results"].append({"config": name, **fields})
+    _flush_partial()
+
+
 def main():
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
@@ -86,32 +163,92 @@ def main():
         arch, image_size = "resnet18", 32
         candidates = [64, 32]
 
-    def best_throughput(**kw):
+    flops_per_sample = _flops_per_sample(arch, image_size)
+    peak = _chip_peak_tflops()
+    _partial.update(arch=arch, image_size=image_size,
+                    device_kind=jax.devices()[0].device_kind,
+                    n_devices=len(jax.devices()),
+                    peak_bf16_tflops=peak)
+
+    def mfu_of(img_per_sec_per_chip: float) -> float | None:
+        if flops_per_sample is None or peak is None or not on_tpu:
+            return None
+        return img_per_sec_per_chip * flops_per_sample / (peak * 1e12)
+
+    def best_throughput(name: str, **kw):
         """Largest-fitting batch from the candidate ladder — each config is
-        measured at ITS OWN best batch size, as a real user would run it."""
+        measured at ITS OWN best batch size, as a real user would run it.
+        ANY per-candidate failure counts as "didn't fit" (see module doc)."""
         for bs in candidates:
             try:
-                return _throughput(bs, image_size, arch, **kw)
-            except Exception as e:  # OOM at this batch — try smaller
-                msg = str(e)
-                if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
-                    continue
-                raise
+                val = _throughput(bs, image_size, arch, **kw)
+            except Exception:
+                print(f"bench: config={name} bs/chip={bs} failed "
+                      f"(treating as did-not-fit):", file=sys.stderr)
+                traceback.print_exc()
+                _record(name, batch_per_chip=bs, fit=False)
+                continue
+            _record(name, batch_per_chip=bs, fit=True,
+                    images_per_sec_per_chip=round(val, 2), mfu=mfu_of(val),
+                    **{k: v for k, v in kw.items() if k != "steps"})
+            return val
         return None
 
-    value = best_throughput(half=True, fuse_views=True,
+    if "--sweep" in sys.argv[1:]:
+        _sweep(arch, image_size, candidates, mfu_of)
+        return
+
+    value = best_throughput("tpu_first", half=True, fuse_views=True,
                             ema_update_mode="post")
-    baseline = best_throughput(half=False, fuse_views=False,
+    baseline = best_throughput("reference_faithful", half=False,
+                               fuse_views=False,
                                ema_update_mode="reference_pre", steps=10)
-    if value is None or baseline is None:
-        raise RuntimeError("no batch size fit in memory")
+    if value is None:
+        raise RuntimeError(
+            "no batch size fit in memory for the primary config; "
+            f"per-candidate tracebacks above, partial log in {_PARTIAL_PATH}")
 
     print(json.dumps({
         "metric": f"{arch}_byol_train_images_per_sec_per_chip",
         "value": round(value, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(value / baseline, 3),
+        "vs_baseline": (round(value / baseline, 3)
+                        if baseline is not None else None),
+        "mfu": (round(mfu_of(value), 4)
+                if mfu_of(value) is not None else None),
     }))
+
+
+def _sweep(arch, image_size, candidates, mfu_of):
+    """Tuning grid: batch x remat x fuse_views, bf16. Results accumulate in
+    bench_partial.json (incremental) and bench_sweep.json (final table)."""
+    rows = []
+    for remat in (False, True):
+        for fuse in (True, False):
+            for bs in candidates:
+                name = f"sweep_bs{bs}_remat{int(remat)}_fuse{int(fuse)}"
+                try:
+                    val = _throughput(bs, image_size, arch, half=True,
+                                      fuse_views=fuse, remat=remat,
+                                      ema_update_mode="post", steps=10)
+                except Exception:
+                    print(f"bench: {name} failed:", file=sys.stderr)
+                    traceback.print_exc()
+                    _record(name, batch_per_chip=bs, fit=False)
+                    continue
+                row = {"batch_per_chip": bs, "remat": remat,
+                       "fuse_views": fuse,
+                       "images_per_sec_per_chip": round(val, 2),
+                       "mfu": mfu_of(val)}
+                rows.append(row)
+                _record(name, fit=True, **row)
+                print(f"bench: {name}: {val:.1f} img/s/chip "
+                      f"mfu={row['mfu']}", file=sys.stderr)
+    with open("bench_sweep.json", "w") as f:
+        json.dump(rows, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"metric": "sweep", "value": len(rows),
+                      "unit": "configs", "vs_baseline": None}))
 
 
 if __name__ == "__main__":

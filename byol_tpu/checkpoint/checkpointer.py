@@ -116,17 +116,44 @@ class CheckpointStore:
         """Restore ``(state, epoch)``; ``abstract_state`` is a shape/sharding
         pytree (e.g. from ``jax.eval_shape`` + ``jax.device_put`` layouts) so
         orbax materializes arrays directly onto the right devices."""
-        if epoch is None:
-            meta = self.read_meta()
-            epoch = (meta.get("best_epoch") if best
-                     else meta.get("last_epoch"))
-            if epoch is None:
-                eps = self.epochs()
-                if not eps:
-                    raise FileNotFoundError(
-                        f"no checkpoints under {self.directory}")
-                epoch = eps[-1]
         self._ckptr.wait_until_finished()  # flush any in-flight async save
+        if epoch is not None:
+            # Explicitly requested epoch: the caller knows what they want —
+            # never silently substitute a different checkpoint.
+            state = self._ckptr.restore(self._path(epoch), abstract_state)
+            return state, int(epoch)
+        meta = self.read_meta()
+        epoch = meta.get("best_epoch") if best else meta.get("last_epoch")
+        # Metadata is written when an async save is SCHEDULED, so a crash
+        # between schedule and commit leaves meta pointing at a ckpt dir that
+        # never materialized (orbax commits atomically via tmp-dir rename).
+        # Never trust meta blindly: verify on disk before restoring.
+        if epoch is not None and not os.path.isdir(self._path(epoch)):
+            print(f"checkpoint: meta points at missing ckpt-{epoch} "
+                  f"(crash before async commit?); falling back to "
+                  f"{'best-metric' if best else 'newest'} on-disk checkpoint")
+            epoch = None
+        if epoch is None:
+            eps = self.epochs()
+            if not eps:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+            if best:
+                # "newest" is typically the WORST post-stall checkpoint, not
+                # the best — pick the best recorded metric among the epochs
+                # that actually survived on disk.
+                history = {h["epoch"]: h["metric"]
+                           for h in meta.get("history", [])
+                           if h.get("metric") is not None}
+                scored = [e for e in eps if e in history]
+                if scored:
+                    larger = bool(meta.get("larger_is_better", False))
+                    epoch = (max if larger else min)(
+                        scored, key=lambda e: history[e])
+                else:
+                    epoch = eps[-1]
+            else:
+                epoch = eps[-1]
         state = self._ckptr.restore(self._path(epoch), abstract_state)
         return state, int(epoch)
 

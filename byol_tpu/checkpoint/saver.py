@@ -51,13 +51,14 @@ class ModelSaver:
         """Record this epoch's metric; save if improved (post burn-in);
         return True when early stopping should trigger (main.py:766-769)."""
         if epoch < self.burn_in_interval:
-            # Burn-in suppresses saves AND best/patience tracking — otherwise
-            # an unsaved burn-in epoch could hold "best" forever and early
-            # stopping would count stalls against a model we never kept.
-            meta = self.store.read_meta()
-            meta.setdefault("history", []).append(
-                {"epoch": epoch, "metric": float(metric)})
-            self.store.write_meta(meta)
+            # Burn-in suppresses best/patience tracking — otherwise an
+            # early epoch could hold "best" forever and early stopping would
+            # count stalls against a model we never kept.  But we still SAVE
+            # (is_best=False) so a preemption during burn-in resumes from
+            # the last epoch instead of restarting from scratch (the
+            # reference loses burn-in progress entirely, main.py:751).
+            self.store.save(epoch, state, metric=float(metric),
+                            is_best=False, keep=self.keep)
             return False
         improved = self._improved(float(metric))
         if improved:
@@ -73,6 +74,9 @@ class ModelSaver:
         meta = self.store.read_meta()
         meta["stall_count"] = self.stall_count
         meta["best_metric"] = self.best_metric
+        # direction persisted so restore(best=True) can pick the best among
+        # surviving checkpoints if the best ckpt dir is lost pre-commit
+        meta["larger_is_better"] = self.larger_is_better
         if stop:
             # Durable terminal marker: a relaunch of an early-stopped run
             # must not burn patience-worth of epochs re-discovering the stop

@@ -19,10 +19,19 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from byol_tpu.objectives.metrics import masked_mean
+
 
 def regression_loss(x: jnp.ndarray, y: jnp.ndarray,
-                    norm_mode: str = "paper") -> jnp.ndarray:
-    """Per-sample negative scaled dot product, shape (B,)."""
+                    norm_mode: str = "paper",
+                    mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Per-sample negative scaled dot product, shape (B,).
+
+    ``mask`` (B,) in {0,1} marks valid rows — needed for pad+mask eval
+    batching.  In ``reference`` mode the Frobenius norms couple samples
+    (Quirk Q2), so padded rows must be zeroed BEFORE the norm or they would
+    perturb every valid sample's loss.
+    """
     x = x.astype(jnp.float32)
     y = y.astype(jnp.float32)
     if norm_mode == "paper":
@@ -30,6 +39,9 @@ def regression_loss(x: jnp.ndarray, y: jnp.ndarray,
         y = y / (jnp.linalg.norm(y, axis=-1, keepdims=True) + 1e-12)
         return -2.0 * jnp.sum(x * y, axis=-1)
     elif norm_mode == "reference":
+        if mask is not None:
+            x = x * mask[:, None]
+            y = y * mask[:, None]
         norm_x = jnp.linalg.norm(x)      # whole-tensor Frobenius norm
         norm_y = jnp.linalg.norm(y)      # (objective.py:8)
         return -2.0 * jnp.sum(x * y, axis=-1) / (norm_x * norm_y)
@@ -38,10 +50,12 @@ def regression_loss(x: jnp.ndarray, y: jnp.ndarray,
 
 def loss_function(online_prediction1, online_prediction2,
                   target_projection1, target_projection2,
-                  norm_mode: str = "paper") -> jnp.ndarray:
-    """Symmetrized BYOL loss, scalar (objective.py:12-25)."""
+                  norm_mode: str = "paper",
+                  mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Symmetrized BYOL loss, scalar (objective.py:12-25).  With ``mask``,
+    the batch mean runs over valid rows only (pad+mask eval batching)."""
     t1 = jax.lax.stop_gradient(target_projection1)
     t2 = jax.lax.stop_gradient(target_projection2)
-    loss_ab = regression_loss(online_prediction1, t2, norm_mode)
-    loss_ba = regression_loss(online_prediction2, t1, norm_mode)
-    return jnp.mean(loss_ab + loss_ba)
+    loss_ab = regression_loss(online_prediction1, t2, norm_mode, mask=mask)
+    loss_ba = regression_loss(online_prediction2, t1, norm_mode, mask=mask)
+    return masked_mean(loss_ab + loss_ba, mask)

@@ -12,14 +12,27 @@ import jax.numpy as jnp
 import optax
 
 
-def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    """Mean softmax cross-entropy over integer labels."""
-    return optax.softmax_cross_entropy_with_integer_labels(
-        logits.astype(jnp.float32), labels).mean()
+def masked_mean(values: jnp.ndarray, mask: jnp.ndarray | None) -> jnp.ndarray:
+    """Mean of ``values`` over rows where ``mask`` is 1 (all rows if None);
+    the shared primitive behind pad+mask eval batching."""
+    if mask is None:
+        return values.mean()
+    return jnp.sum(values * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                  mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Mean softmax cross-entropy over integer labels; ``mask`` (B,) in
+    {0,1} restricts the mean to valid rows (pad+mask eval batching)."""
+    per = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels)
+    return masked_mean(per, mask)
 
 
 def topk_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
-                  topk: Sequence[int] = (1, 5)) -> Tuple[jnp.ndarray, ...]:
+                  topk: Sequence[int] = (1, 5),
+                  mask: jnp.ndarray | None = None
+                  ) -> Tuple[jnp.ndarray, ...]:
     """Top-k accuracies in PERCENT, the ``helpers.metrics.topk`` contract
     consumed at reference main.py:598 (logged as top1/top5)."""
     maxk = min(max(topk), logits.shape[-1])
@@ -28,6 +41,6 @@ def topk_accuracy(logits: jnp.ndarray, labels: jnp.ndarray,
     out = []
     for k in topk:
         k_eff = min(k, maxk)
-        acc = jnp.any(correct[:, :k_eff], axis=-1).astype(jnp.float32).mean()
-        out.append(acc * 100.0)
+        hits = jnp.any(correct[:, :k_eff], axis=-1).astype(jnp.float32)
+        out.append(masked_mean(hits, mask) * 100.0)
     return tuple(out)

@@ -9,7 +9,6 @@ metric the reference never measured (SURVEY.md §5.1).
 """
 from __future__ import annotations
 
-import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -22,13 +21,22 @@ class MetricAccumulator:
 
     The sum is accumulated with device ops (async dispatch) — no host sync
     per step, so the trainer's hot loop keeps running ahead of the chip;
-    the only block is the ``result()`` readback at the epoch boundary."""
+    the only block is the ``result()`` readback at the epoch boundary.
+
+    A metric dict containing ``_weight`` (per-batch valid-sample count, from
+    pad+mask eval batching) is accumulated as a weighted mean instead: each
+    metric is a mean over ``_weight`` samples, so the epoch value is
+    sum(metric*w)/sum(w).  ``_weight`` never appears in ``result()``."""
 
     def __init__(self) -> None:
         self._sum: Optional[Any] = None
         self.count = 0
 
     def update(self, metrics: Any) -> None:
+        if isinstance(metrics, dict) and "_weight" in metrics:
+            w = metrics["_weight"]
+            metrics = {k: (v if k == "_weight" else v * w)
+                       for k, v in metrics.items()}
         if self._sum is None:
             self._sum = metrics
         else:
@@ -39,8 +47,19 @@ class MetricAccumulator:
     def result(self) -> Dict[str, np.ndarray]:
         if self._sum is None:
             return {}
+        if isinstance(self._sum, dict) and "_weight" in self._sum:
+            total = float(np.asarray(self._sum["_weight"]))
+            return {k: np.asarray(v) / max(total, 1.0)
+                    for k, v in self._sum.items() if k != "_weight"}
         return jax.tree_util.tree_map(
             lambda s: np.asarray(s) / self.count, self._sum)
+
+    def total_weight(self) -> Optional[float]:
+        """Total valid-sample count when metrics carried ``_weight`` (pad+
+        mask eval), else None — lets the epoch log report true samples."""
+        if isinstance(self._sum, dict) and "_weight" in self._sum:
+            return float(np.asarray(self._sum["_weight"]))
+        return None
 
 
 def epoch_log_line(prefix: str, epoch: int, num_samples: int,
@@ -58,29 +77,31 @@ def epoch_log_line(prefix: str, epoch: int, num_samples: int,
 
 
 class StepTimer:
-    """images/sec/chip over a sliding window; host-side, no device syncs
-    (call .tick() after the async dispatch returns, and read .rate() only
-    at epoch boundaries where metrics force a block anyway)."""
+    """images/sec/chip measured ONLY over host-synchronized intervals.
 
-    def __init__(self, global_batch: int, n_chips: int, window: int = 50):
+    Per-step host timestamps taken after async dispatch are meaningless —
+    the host runs ahead of the chip, and on tunneled platforms (axon) even
+    ``block_until_ready`` returns at dispatch-ack, so a dispatch-timed rate
+    can overstate by orders of magnitude.  The trainer instead calls
+    ``record_epoch`` with an elapsed time whose endpoint is a D2H metric
+    READBACK (``MetricAccumulator.result()``), which cannot complete before
+    every step in the epoch has: the resulting rate is honest end-to-end
+    throughput including the input pipeline (the same sync discipline as
+    bench.py's scalar readback)."""
+
+    def __init__(self, global_batch: int, n_chips: int):
         self.global_batch = global_batch
         self.n_chips = max(n_chips, 1)
-        self.window = window
-        self._times = []
+        self._rate = 0.0
 
-    def tick(self) -> None:
-        self._times.append(time.perf_counter())
-        if len(self._times) > self.window + 1:
-            self._times.pop(0)
-
-    def reset_window(self) -> None:
-        """Call at epoch start so inter-epoch work (eval, checkpoint, TB
-        flush) never lands inside a tick interval."""
-        self._times = []
+    def record_epoch(self, steps: int, elapsed_s: float) -> None:
+        """Record one epoch's synchronized (steps, wall-clock) measurement;
+        ``elapsed_s`` must end AFTER a device readback that depends on every
+        step (see class docstring)."""
+        if steps > 0 and elapsed_s > 0.0:
+            self._rate = (self.global_batch * steps / elapsed_s
+                          / self.n_chips)
 
     def images_per_sec_per_chip(self) -> float:
-        if len(self._times) < 2:
-            return 0.0
-        dt = self._times[-1] - self._times[0]
-        steps = len(self._times) - 1
-        return self.global_batch * steps / dt / self.n_chips
+        """Most recent epoch's rate (0.0 before the first epoch ends)."""
+        return self._rate

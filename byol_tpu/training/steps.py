@@ -179,6 +179,11 @@ def make_eval_step(net, scfg: StepConfig, policy: Policy = FP32):
         aug1 = policy.cast_to_compute(batch["view1"])
         aug2 = policy.cast_to_compute(batch["view2"])
         labels = batch["label"]
+        # Optional validity mask for pad+mask eval batching: the trainer pads
+        # the final (non-divisible) test batch to the fixed batch shape so
+        # every eval batch hits ONE compiled executable, and masks the pad
+        # rows out of every metric.
+        mask = batch.get("mask")
 
         params = state.params
         if scfg.polyak_ema > 0.0 and state.polyak_params is not None:
@@ -193,15 +198,21 @@ def make_eval_step(net, scfg: StepConfig, policy: Policy = FP32):
 
         byol_loss = loss_function(
             on1["prediction"], on2["prediction"],
-            tgt1["projection"], tgt2["projection"], norm_mode=scfg.norm_mode)
+            tgt1["projection"], tgt2["projection"], norm_mode=scfg.norm_mode,
+            mask=mask)
         logits = net.apply({"params": params}, on1["representation"],
                            method="classify")
-        cls_loss = cross_entropy(logits, labels)
-        top1, top5 = topk_accuracy(logits, labels)
+        cls_loss = cross_entropy(logits, labels, mask=mask)
+        top1, top5 = topk_accuracy(logits, labels, mask=mask)
+        weight = (jnp.sum(mask) if mask is not None
+                  else jnp.asarray(labels.shape[0], jnp.float32))
         return {"loss_mean": byol_loss + cls_loss,
                 "byol_loss_mean": byol_loss,
                 "linear_loss_mean": cls_loss,
                 "top1_mean": top1,
-                "top5_mean": top5}
+                "top5_mean": top5,
+                # sample count backing the means above; MetricAccumulator
+                # weights by it so padded batches don't skew epoch metrics
+                "_weight": weight}
 
     return eval_step

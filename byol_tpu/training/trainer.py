@@ -51,6 +51,27 @@ class FitResult:
     images_per_sec_per_chip: float
 
 
+def _pad_eval_batch(batch: Dict[str, np.ndarray], target: int
+                    ) -> Dict[str, np.ndarray]:
+    """Pad a (possibly short, non-divisible) eval batch up to ``target`` rows
+    and attach a validity ``mask``.  Every eval batch then has ONE static
+    shape — a single XLA compile, and a final batch that isn't divisible by
+    the mesh's data axis still shards cleanly.  The eval step masks pad rows
+    out of every metric and returns the valid count as ``_weight``."""
+    n = len(batch["label"])
+    mask = np.zeros((target,), np.float32)
+    mask[:n] = 1.0
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        if n < target:
+            pad = np.zeros((target - n,) + v.shape[1:], v.dtype)
+            v = np.concatenate([v, pad], axis=0)
+        out[k] = v
+    out["mask"] = mask
+    return out
+
+
 def _range_check(batch: Dict[str, np.ndarray]) -> None:
     """The reference's startup input contract: augmented pixels must stay in
     [0,1] (main.py:486-490) — hard failure, not a warning."""
@@ -110,9 +131,23 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     saver = ModelSaver(
         os.path.join(cfg.model.model_dir, name),
         early_stop=cfg.optim.early_stop,
-        burn_in_interval=max(int(0.1 * cfg.task.epochs), 1),
+        burn_in_interval=int(0.1 * cfg.task.epochs),
         larger_is_better=False,
         max_early_stop_steps=10)
+
+    # Eval batches are padded to the fixed per-host batch so all of them
+    # share one compiled executable and shard cleanly on the data axis.
+    host_eval_batch = rcfg.global_batch_size // jax.process_count()
+
+    def run_eval(state) -> MetricAccumulator:
+        acc = MetricAccumulator()
+        for batch in loader.test_loader:
+            dev_batch = shard_batch_to_mesh(
+                _pad_eval_batch(batch, host_eval_batch), mesh)
+            acc.update(eval_step(state, dev_batch))
+            if cfg.device.debug_step:
+                break
+        return acc
 
     init_epoch = 0
     if saver.stopped_early:
@@ -120,11 +155,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         # metadata): restore the best state and return without re-burning
         # patience-worth of epochs.
         state, init_epoch = saver.restore(state, best=True)
-        acc = MetricAccumulator()
-        for batch in loader.test_loader:
-            acc.update(eval_step(state, shard_batch_to_mesh(batch, mesh)))
-            if cfg.device.debug_step:
-                break
+        acc = run_eval(state)
         test_metrics = {k: float(v) for k, v in acc.result().items()}
         if verbose:
             print(f"run already early-stopped at best epoch "
@@ -135,7 +166,11 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                          test_metrics=test_metrics, stopped_early=True,
                          images_per_sec_per_chip=0.0)
     if saver.has_checkpoint():
-        state, init_epoch = saver.restore(state, best=True)
+        # Plain resume continues from the LAST checkpoint — restoring BEST
+        # here would silently discard all post-best training and reset the
+        # persisted patience counter on every relaunch.  Best-restore is
+        # reserved for the early-stop terminal path (main.py:767-769).
+        state, init_epoch = saver.restore(state, best=False)
         if verbose:
             print(f"resumed from epoch {init_epoch - 1} "
                   f"(best loss {saver.best_metric})")
@@ -153,7 +188,6 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         acc = MetricAccumulator()
         t0 = time.time()
         sample_batch = None
-        timer.reset_window()  # don't fold the eval/ckpt gap into step rate
 
         def tapped_batches():
             nonlocal first_batch_checked, sample_batch
@@ -169,7 +203,6 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         # double-buffered H2D: batch N+1 transfers while step N computes
         for dev_batch in prefetch_to_mesh(tapped_batches(), mesh):
             state, metrics = train_step(state, dev_batch)
-            timer.tick()
             acc.update(metrics)  # device-side running sum; no host sync
             if cfg.device.fault_at_step and \
                     int(state.step) == cfg.device.fault_at_step:
@@ -181,24 +214,28 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
             if cfg.device.debug_step:  # single-minibatch smoke (main.py:630)
                 break
         train_metrics = {k: float(v) for k, v in acc.result().items()}
+        # acc.result() is a D2H readback of sums depending on every step —
+        # the only sync this platform can't fake, so the elapsed time (and
+        # the throughput derived from it) is honest (StepTimer docstring).
+        train_elapsed = time.time() - t0
+        timer.record_epoch(acc.count, train_elapsed)
         if verbose:
             print(epoch_log_line("train", epoch,
                                  acc.count * rcfg.global_batch_size,
-                                 time.time() - t0, train_metrics))
+                                 train_elapsed, train_metrics))
 
         # ---- eval (prefix='test', main.py:680-692) -----------------------
-        acc = MetricAccumulator()
         t0 = time.time()
-        for batch in loader.test_loader:
-            dev_batch = shard_batch_to_mesh(batch, mesh)
-            acc.update(eval_step(state, dev_batch))
-            if cfg.device.debug_step:
-                break
+        acc = run_eval(state)
         test_metrics = {k: float(v) for k, v in acc.result().items()}
         if verbose:
-            print(epoch_log_line("test", epoch,
-                                 acc.count * rcfg.global_batch_size,
-                                 time.time() - t0, test_metrics))
+            # total_weight = exact valid rows (pad rows excluded)
+            n_eval = acc.total_weight()
+            print(epoch_log_line(
+                "test", epoch,
+                int(n_eval) if n_eval is not None
+                else acc.count * rcfg.global_batch_size,
+                time.time() - t0, test_metrics))
 
         # ---- observability (main.py:646-657,764,773-779) -----------------
         grapher.register_plots(train_metrics, epoch, prefix="train")
@@ -222,11 +259,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         # ---- checkpoint + early stop (main.py:766-769) -------------------
         if saver(test_metrics.get("loss_mean", float("inf")), epoch, state):
             state, _ = saver.restore(state, best=True)
-            acc = MetricAccumulator()
-            for batch in loader.test_loader:
-                acc.update(eval_step(state, shard_batch_to_mesh(batch, mesh)))
-                if cfg.device.debug_step:
-                    break
+            acc = run_eval(state)
             test_metrics = {k: float(v) for k, v in acc.result().items()}
             stopped = True
             if verbose:

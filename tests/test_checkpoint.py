@@ -84,10 +84,11 @@ def test_model_saver_burn_in_and_best(mesh8, tmp_path):
     _, (net, state, train_step, _, _) = _tiny_setup(mesh8, tmp_path)
     saver = ModelSaver(str(tmp_path / "ms"), early_stop=False,
                        burn_in_interval=2, keep=2)
-    # epochs 0,1 are burn-in: metric tracked, nothing written.
+    # epochs 0,1 are burn-in: saved for preemption-resume, but never "best".
     assert not saver(1.0, 0, state)
     assert not saver(0.9, 1, state)
-    assert not saver.has_checkpoint()
+    assert saver.has_checkpoint()
+    assert "best_epoch" not in saver.store.read_meta()
     # epoch 2 improves -> becomes best.
     assert not saver(0.5, 2, state)
     assert saver.has_checkpoint()
@@ -156,6 +157,103 @@ def test_early_stop_marker_is_durable(tmp_path):
     # and the best checkpoint is still restorable
     restored, next_epoch = relaunched.restore(state, best=True)
     assert next_epoch == 1
+    relaunched.close()
+
+
+def test_plain_resume_uses_last_not_best(tmp_path):
+    """A plain relaunch must continue from the LAST checkpoint — restoring
+    best would discard post-best training on every restart (round-1 advisor
+    finding; reference contract main.py:753-754 resumes, best-restore is the
+    early-stop terminal path main.py:767-769)."""
+    saver = ModelSaver(str(tmp_path / "pl"), early_stop=False, keep=3)
+    saver(0.5, 0, {"w": jnp.zeros((2,))})       # best
+    saver(0.9, 1, {"w": jnp.ones((2,))})        # worse, last
+    saver.close()
+    relaunched = ModelSaver(str(tmp_path / "pl"), early_stop=False)
+    restored, next_epoch = relaunched.restore({"w": jnp.zeros((2,))},
+                                              best=False)
+    assert next_epoch == 2                       # continues after epoch 1
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.ones((2,)))
+    # stall count must NOT be reset by a plain (last) resume
+    assert relaunched.stall_count == 1
+    restored_best, next_best = relaunched.restore({"w": jnp.zeros((2,))},
+                                                  best=True)
+    assert next_best == 1
+    np.testing.assert_array_equal(np.asarray(restored_best["w"]),
+                                  np.zeros((2,)))
+    relaunched.close()
+
+
+def test_restore_falls_back_when_meta_points_at_missing_ckpt(tmp_path):
+    """Crash between async-save schedule and commit: meta.json names a
+    ckpt dir that never hit disk.  restore() must fall back to the newest
+    on-disk checkpoint instead of raising (round-1 advisor finding)."""
+    import shutil
+    store = CheckpointStore(str(tmp_path / "crash"))
+    store.save(0, {"w": jnp.zeros((2,))})
+    store.save(1, {"w": jnp.ones((2,))}, metric=0.1, is_best=True)
+    store._ckptr.wait_until_finished()
+    # Simulate the crash: ckpt-1 committed in meta but gone from disk.
+    shutil.rmtree(str(tmp_path / "crash" / "ckpt-1"))
+    assert store.read_meta()["last_epoch"] == 1
+    restored, epoch = store.restore(abstract_like({"w": jnp.zeros((2,))}))
+    assert epoch == 0
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.zeros((2,)))
+    # best also points at the vanished ckpt -> same fallback
+    restored, epoch = store.restore(abstract_like({"w": jnp.zeros((2,))}),
+                                    best=True)
+    assert epoch == 0
+    store.close()
+
+
+def test_best_fallback_picks_best_surviving_metric(tmp_path):
+    """When the best ckpt dir is lost pre-commit, restore(best=True) must
+    pick the best-metric SURVIVING checkpoint, not simply the newest (which
+    after an early-stop stall is typically the worst)."""
+    import shutil
+    store = CheckpointStore(str(tmp_path / "bf"))
+    vals = {0: 0.5, 1: 0.2, 2: 0.9, 3: 0.1}
+    for e, m in vals.items():
+        store.save(e, {"w": jnp.full((2,), float(e))}, metric=m,
+                    is_best=(m == min(list(vals.values())[:e + 1])),
+                    keep=10)
+    store._ckptr.wait_until_finished()
+    shutil.rmtree(str(tmp_path / "bf" / "ckpt-3"))   # lose the best
+    restored, epoch = store.restore(abstract_like({"w": jnp.zeros((2,))}),
+                                    best=True)
+    assert epoch == 1                                # 0.2 beats 0.5 and 0.9
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full((2,), 1.0))
+    store.close()
+
+
+def test_explicit_epoch_restore_never_substitutes(tmp_path):
+    """An explicitly requested epoch must raise if missing — silent
+    substitution is only for meta-derived epochs."""
+    store = CheckpointStore(str(tmp_path / "ex"))
+    store.save(0, {"w": jnp.zeros((2,))})
+    store._ckptr.wait_until_finished()
+    with pytest.raises(Exception):
+        store.restore(abstract_like({"w": jnp.zeros((2,))}), epoch=7)
+    store.close()
+
+
+def test_burn_in_preemption_resume(tmp_path):
+    """Preemption during burn-in must be resumable: burn-in epochs are saved
+    (as last) even though best/patience tracking is suppressed."""
+    saver = ModelSaver(str(tmp_path / "bires"), early_stop=True,
+                       burn_in_interval=10, max_early_stop_steps=3)
+    saver(1.0, 0, {"w": jnp.zeros((2,))})
+    saver(0.9, 1, {"w": jnp.ones((2,))})
+    saver.close()
+    relaunched = ModelSaver(str(tmp_path / "bires"), early_stop=True,
+                            burn_in_interval=10, max_early_stop_steps=3)
+    assert relaunched.has_checkpoint()
+    restored, next_epoch = relaunched.restore({"w": jnp.zeros((2,))},
+                                              best=False)
+    assert next_epoch == 2
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.ones((2,)))
+    assert relaunched.best_metric is None and relaunched.stall_count == 0
     relaunched.close()
 
 

@@ -88,3 +88,45 @@ class TestMetrics:
 def pytest_approx(x, rel=1e-5):
     import pytest
     return pytest.approx(x, rel=rel)
+
+
+class TestMaskedMetrics:
+    """Pad+mask eval batching: metrics over a padded batch with a mask must
+    equal the same metrics over the unpadded batch, in both norm modes."""
+
+    def _padded(self, arrs, pad_to):
+        out = []
+        for a in arrs:
+            pad = np.zeros((pad_to - a.shape[0],) + a.shape[1:], a.dtype)
+            out.append(np.concatenate([a, pad], axis=0))
+        return out
+
+    def test_masked_loss_matches_unpadded(self):
+        rng = np.random.RandomState(3)
+        arrs = [rng.randn(5, 16).astype(np.float32) for _ in range(4)]
+        padded = self._padded(arrs, 8)
+        mask = jnp.asarray([1.0] * 5 + [0.0] * 3)
+        for mode in ("paper", "reference"):
+            want = loss_function(*map(jnp.asarray, arrs), norm_mode=mode)
+            got = loss_function(*map(jnp.asarray, padded), norm_mode=mode,
+                                mask=mask)
+            np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+    def test_masked_cls_metrics_match_unpadded(self):
+        rng = np.random.RandomState(4)
+        logits = rng.randn(5, 10).astype(np.float32)
+        labels = rng.randint(0, 10, size=(5,)).astype(np.int32)
+        plogits, = self._padded([logits], 8)
+        # pad labels with an arbitrary (wrong-by-construction) class
+        plabels = np.concatenate([labels, np.zeros((3,), np.int32)])
+        mask = jnp.asarray([1.0] * 5 + [0.0] * 3)
+        np.testing.assert_allclose(
+            float(cross_entropy(jnp.asarray(plogits), jnp.asarray(plabels),
+                                mask=mask)),
+            float(cross_entropy(jnp.asarray(logits), jnp.asarray(labels))),
+            rtol=1e-5)
+        want = topk_accuracy(jnp.asarray(logits), jnp.asarray(labels))
+        got = topk_accuracy(jnp.asarray(plogits), jnp.asarray(plabels),
+                            mask=mask)
+        for w, g in zip(want, got):
+            np.testing.assert_allclose(float(g), float(w), rtol=1e-5)

@@ -74,5 +74,19 @@ def test_epoch_log_line_format():
 
 def test_step_timer_rate():
     t = StepTimer(global_batch=100, n_chips=4)
-    t._times = [0.0, 1.0, 2.0]  # 2 steps over 2s
+    assert t.images_per_sec_per_chip() == 0.0  # nothing recorded yet
+    t.record_epoch(steps=2, elapsed_s=2.0)     # 2 synced steps over 2s
     assert abs(t.images_per_sec_per_chip() - 100 * 2 / 2.0 / 4) < 1e-9
+    t.record_epoch(steps=0, elapsed_s=0.0)     # degenerate epoch: keep last
+    assert t.images_per_sec_per_chip() > 0.0
+
+
+def test_metric_accumulator_weighted_by_valid_count():
+    """Eval metrics carry _weight (valid rows under pad+mask batching); the
+    epoch mean must weight batches by it, and _weight must not leak out."""
+    acc = MetricAccumulator()
+    acc.update({"top1_mean": np.float32(100.0), "_weight": np.float32(3.0)})
+    acc.update({"top1_mean": np.float32(0.0), "_weight": np.float32(1.0)})
+    out = acc.result()
+    assert out["top1_mean"] == 75.0          # (100*3 + 0*1) / 4
+    assert "_weight" not in out
