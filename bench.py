@@ -244,9 +244,13 @@ def _sweep(arch, image_size, candidates, mfu_of):
                 _record(name, fit=True, **row)
                 print(f"bench: {name}: {val:.1f} img/s/chip "
                       f"mfu={row['mfu']}", file=sys.stderr)
-    with open("bench_sweep.json", "w") as f:
-        json.dump(rows, f, indent=2)
-        f.write("\n")
+    try:
+        with open("bench_sweep.json", "w") as f:
+            json.dump(rows, f, indent=2)
+            f.write("\n")
+    except OSError as e:  # same contract as _flush_partial: a read-only fs
+        print(f"bench: could not write bench_sweep.json: {e}",
+              file=sys.stderr)
     print(json.dumps({"metric": "sweep", "value": len(rows),
                       "unit": "configs", "vs_baseline": None}))
 

@@ -32,7 +32,8 @@ def build_parser() -> argparse.ArgumentParser:
     t = p.add_argument_group("task")
     t.add_argument("--task", type=str, default="image_folder",
                    help="image_folder | cifar10 | cifar100 | mnist | "
-                        "fashion_mnist | fake")
+                        "fashion_mnist | fake | synth (procedural "
+                        "learnable dataset, works offline)")
     t.add_argument("--batch-size", type=int, default=4096,
                    help="GLOBAL batch size")
     t.add_argument("--epochs", type=int, default=3000)
@@ -74,6 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--workers-per-replica", type=int, default=2)
     d.add_argument("--distributed-master", type=str, default="",
                    help="JAX coordinator address (multi-host)")
+    d.add_argument("--num-processes", type=int, default=0,
+                   help="host PROCESS count for explicit multi-host "
+                        "rendezvous; distinct from --num-replicas (a DEVICE "
+                        "axis size — hosts usually drive several chips). "
+                        "0 = let JAX auto-detect from the TPU pod metadata")
     d.add_argument("--distributed-rank", type=int, default=0)
     d.add_argument("--distributed-port", type=int, default=29300)
     d.add_argument("--debug-step", action="store_true",
@@ -84,6 +90,14 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--fault-at-step", type=int, default=0,
                    help="fault injection: kill the process at step N "
                         "(tests checkpoint/resume)")
+    d.add_argument("--save-on-signal",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="on SIGTERM (pod preemption notice) checkpoint "
+                        "immediately and exit 143")
+    d.add_argument("--watchdog-timeout", type=float, default=0.0,
+                   help="seconds without epoch progress before dumping all "
+                        "thread stacks and dying (hung-collective "
+                        "detector; 0 = off)")
     d.add_argument("--shard-eval", action="store_true",
                    help="shard the test set across hosts (reference "
                         "evaluates it fully on every rank, Quirk Q9)")
@@ -107,9 +121,10 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--pooling", type=str, default="cls",
                    choices=("cls", "gap"), help="ViT feature pooling")
     x.add_argument("--data-backend", type=str, default="tf",
-                   choices=("tf", "native"),
-                   help="host pipeline: tf.data or the native C++ kernel "
-                        "(DALI-equivalent)")
+                   choices=("tf", "native", "device"),
+                   help="augmentation pipeline: tf.data host, native C++ "
+                        "host kernel, or on-chip jitted augmentation "
+                        "(both DALI analogs; 'device' ships uint8 to HBM)")
     x.add_argument("--loss-norm-mode", type=str, default="paper",
                    choices=("paper", "reference"), help="Quirk Q2 switch")
     x.add_argument("--ema-init-mode", type=str, default="copy",
@@ -163,6 +178,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             debug_step=args.debug_step, seed=args.seed, half=args.half,
             check_numerics=args.check_numerics,
             fault_at_step=args.fault_at_step,
+            save_on_signal=args.save_on_signal,
+            watchdog_timeout=args.watchdog_timeout,
             shard_eval=args.shard_eval,
             model_parallel=args.model_parallel,
             sequence_parallel=args.sequence_parallel),
@@ -184,13 +201,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         master = args.distributed_master
         if ":" not in master:
             master = f"{master}:{args.distributed_port}"
-        # On TPU pods JAX auto-detects process identity; --num-replicas +
-        # --distributed-rank pin it explicitly elsewhere (the reference's
-        # one-process-per-node topology, main.py:807-810).
-        explicit = args.num_replicas > 0
+        # On TPU pods JAX auto-detects process identity; --num-processes +
+        # --distributed-rank pin it explicitly (the reference's
+        # one-process-per-node topology, main.py:807-810).  NB this is the
+        # PROCESS count, not --num-replicas: a host usually drives several
+        # chips, so device-axis size != process count.
+        explicit = args.num_processes > 0
         initialize_distributed(
             master,
-            num_processes=args.num_replicas if explicit else None,
+            num_processes=args.num_processes if explicit else None,
             process_id=args.distributed_rank if explicit else None)
     cfg = config_from_args(args)
     print(cfg.to_json())  # full-config dump at startup (main.py:743)

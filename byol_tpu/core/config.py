@@ -32,8 +32,10 @@ class TaskConfig:
     image_size_override: Optional[int] = 224  # ref main.py:46-47
     log_dir: str = "./runs"
     uid: str = ""                       # run identity (ref main.py:52-53)
-    # Host pipeline backend for array datasets: 'tf' (tf.data) or 'native'
-    # (multithreaded C++ kernel, the DALI-equivalent — data/native/).
+    # Augmentation backend for array datasets: 'tf' (tf.data host), 'native'
+    # (multithreaded C++ host kernel, data/native/), or 'device' (on-chip
+    # jitted two-view augmentation, data/device_augment.py).  The latter two
+    # are the DALI equivalents (reference main.py:356-382).
     data_backend: str = "tf"
 
 
@@ -103,6 +105,11 @@ class DeviceConfig:
     check_numerics: bool = False        # jax_debug_nans: fail fast on NaN/inf
     fault_at_step: int = 0              # >0: kill the process at step N to
                                         # exercise preemption/resume paths
+    save_on_signal: bool = True         # SIGTERM (pod preemption notice) ->
+                                        # checkpoint immediately, exit 143
+    watchdog_timeout: float = 0.0       # >0: dump all stacks + die if an
+                                        # epoch readback stalls this many
+                                        # seconds (hung-collective detector)
     shard_eval: bool = False            # shard the test set across hosts
                                         # (Quirk Q9: reference evaluates the
                                         # full test set on every rank)

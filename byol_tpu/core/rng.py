@@ -26,8 +26,3 @@ def split_named(key: jax.Array, names: Sequence[str]) -> Dict[str, jax.Array]:
 def for_step(key: jax.Array, step) -> jax.Array:
     """Per-step derived key; `step` may be a traced int32 scalar."""
     return jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))
-
-
-def per_device_key(key: jax.Array, axis_index) -> jax.Array:
-    """Decorrelate per-device randomness inside shard_map/pmap bodies."""
-    return jax.random.fold_in(key, axis_index)

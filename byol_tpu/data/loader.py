@@ -154,6 +154,40 @@ def _native_pipeline(images: np.ndarray, labels: np.ndarray, *,
     return make
 
 
+def _device_pipeline(images: np.ndarray, labels: np.ndarray, *,
+                     batch_size: int, image_size: int, train: bool,
+                     color_jitter_strength: float, seed: int, shuffle: bool
+                     ) -> Callable[[int], Iterator[Batch]]:
+    """On-device (TPU) two-view augmentation backend — the DALI analog that
+    actually uses the accelerator (data/device_augment.py).
+
+    The host ships raw uint8 batches (4x less H2D bandwidth than float32
+    views); crop/flip/jitter/grayscale/blur run on chip in one jitted vmapped
+    program.  Train only — ``get_loader`` routes eval through the host
+    resize path, where augmentation throughput is irrelevant."""
+    from byol_tpu.core import rng as rng_lib
+    from byol_tpu.data import device_augment
+
+    labels = labels.astype(np.int32)
+
+    def make(epoch: int) -> Iterator[Batch]:
+        idx = np.arange(len(labels))
+        if shuffle:
+            np.random.RandomState(seed + epoch).shuffle(idx)
+        n = len(idx)
+        end = n - (n % batch_size) if train else n
+        # per-epoch key stream: the set_all_epochs reseed (main.py:760)
+        epoch_key = rng_lib.for_step(rng_lib.root_key(seed), epoch)
+        for i, lo in enumerate(range(0, end, batch_size)):
+            take = idx[lo:lo + batch_size]
+            v1, v2 = device_augment.two_view_batch(
+                rng_lib.for_step(epoch_key, i), images[take], image_size,
+                strength=color_jitter_strength)
+            yield {"view1": v1, "view2": v2, "label": labels[take]}
+
+    return make
+
+
 def get_loader(cfg: Config, *, num_fake_samples: int = 512,
                shard_eval: bool = False) -> LoaderBundle:
     """Dispatch on ``cfg.task.task``; see module docstring for the contract.
@@ -189,6 +223,15 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
         x_te, y_te = readers.load_fake(max(num_fake_samples // 4, host_batch),
                                        size, seed=cfg.device.seed + 1)
         n_classes = 10
+    elif task == "synth":
+        # learnable procedural dataset (readers.load_synth) — the offline
+        # stand-in for CIFAR-scale learning-dynamics evidence
+        size = cfg.task.image_size_override or 32
+        x_tr, y_tr = readers.load_synth(20_000, size, seed=cfg.device.seed,
+                                        train=True)
+        x_te, y_te = readers.load_synth(max(2_000, host_batch), size,
+                                        seed=cfg.device.seed, train=False)
+        n_classes = 10
     elif task in readers.ARRAY_LOADERS:
         fn, n_classes = readers.ARRAY_LOADERS[task]
         x_tr, y_tr = fn(cfg.task.data_dir, train=True,
@@ -218,16 +261,22 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
         pipeline = functools.partial(
             _native_pipeline,
             num_threads=max(cfg.device.workers_per_replica, 1))
+        test_pipeline = pipeline
     elif backend == "tf":
-        pipeline = _array_pipeline
+        pipeline = test_pipeline = _array_pipeline
+    elif backend == "device":
+        # on-chip train augmentation; eval resize stays on host (its
+        # throughput never gates the MXU)
+        pipeline, test_pipeline = _device_pipeline, _array_pipeline
     else:
         raise ValueError(
-            f"unknown data_backend {cfg.task.data_backend!r} ('tf'|'native')")
+            f"unknown data_backend {cfg.task.data_backend!r} "
+            f"('tf'|'native'|'device')")
     return LoaderBundle(
         make_train_iter=pipeline(
             x_trs, y_trs, batch_size=host_batch, image_size=size, train=True,
             color_jitter_strength=cj, seed=cfg.device.seed, shuffle=True),
-        make_test_iter=pipeline(
+        make_test_iter=test_pipeline(
             x_te, y_te, batch_size=host_batch, image_size=size, train=False,
             color_jitter_strength=cj, seed=cfg.device.seed, shuffle=False),
         input_shape=(size, size, 3),

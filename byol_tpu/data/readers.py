@@ -137,6 +137,45 @@ def load_fake(num_samples: int = 512, image_size: int = 32,
     return x, y
 
 
+def load_synth(num_samples: int = 10_000, image_size: int = 32,
+               num_classes: int = 10, seed: int = 0, train: bool = True
+               ) -> Arrays:
+    """Procedural LEARNABLE dataset for offline learning-dynamics evidence.
+
+    ``fake`` is pure noise (nothing to learn); ``synth`` gives each class a
+    fixed smooth color template (4x4 noise upsampled bilinearly to full
+    resolution) and renders samples as template + per-sample brightness +
+    pixel noise.  Smooth templates keep local crops correlated with class
+    identity, so BYOL's crop-invariance objective has real signal and the
+    concurrent linear probe must beat chance by a wide margin if (and only
+    if) representation learning works.  Templates depend only on
+    (num_classes, image_size), never on ``train``, so train/test share
+    classes but not samples.
+    """
+    tmpl_rng = np.random.RandomState(123)           # class identity, fixed
+    rng = np.random.RandomState(seed + (0 if train else 10_007))
+    # smooth per-class color fields in [0.2, 0.8]
+    coarse = tmpl_rng.rand(num_classes, 4, 4, 3)
+    xs = np.linspace(0, 3, image_size)
+    i0 = np.clip(np.floor(xs).astype(int), 0, 2)
+    frac = xs - i0                                  # (S,)
+    def _up(t):                                     # bilinear 4x4 -> S x S
+        t = (t[i0] * (1 - frac)[:, None, None]
+             + t[i0 + 1] * frac[:, None, None])                 # rows
+        t = (t[:, i0] * (1 - frac)[None, :, None]
+             + t[:, i0 + 1] * frac[None, :, None])              # cols
+        return t
+    templates = np.stack([0.2 + 0.6 * _up(c) for c in coarse])  # (C,S,S,3)
+
+    y = rng.randint(0, num_classes, size=(num_samples,))
+    gain = rng.uniform(0.6, 1.0, size=(num_samples, 1, 1, 1))
+    bias = rng.uniform(-0.1, 0.1, size=(num_samples, 1, 1, 1))
+    noise = rng.normal(0.0, 0.06, size=(num_samples, image_size,
+                                        image_size, 3))
+    x = np.clip(templates[y] * gain + bias + noise, 0.0, 1.0)
+    return (x * 255).astype(np.uint8), y.astype(np.int64)
+
+
 ARRAY_LOADERS = {
     "cifar10": (load_cifar10, 10),
     "cifar100": (load_cifar100, 100),

@@ -1,0 +1,62 @@
+"""Collective-deadlock watchdog — the SURVEY.md §5.2 hygiene the reference
+lacks entirely (a dead NCCL rank just hangs the job; the reference README
+tells the operator to expect NCCL errors, README.md:42).
+
+Under SPMD a lost host / wedged interconnect shows up as a collective that
+never completes, which on the host side means the epoch's metric READBACK
+never returns.  The watchdog arms a deadline around that readback: if no
+progress is reported within ``timeout_s``, every thread's stack is dumped
+(so the operator sees exactly which collective/readback is stuck) and the
+process optionally dies so the scheduler can requeue it — hung-forever jobs
+are the failure mode this prevents.
+
+Built on ``faulthandler.dump_traceback_later`` — async-signal-safe, fires
+even when the main thread is blocked inside an XLA runtime call (a plain
+Python timer thread could not preempt that reliably... it could run, but
+could not introspect the blocked frame; faulthandler dumps it).
+"""
+from __future__ import annotations
+
+import faulthandler
+import sys
+from typing import Optional, TextIO
+
+
+class Watchdog:
+    """Progress watchdog: ``pet()`` before each potentially-blocking region
+    (epoch readback, eval, checkpoint flush); if the next ``pet()`` or
+    ``stop()`` doesn't arrive within ``timeout_s``, all thread stacks are
+    dumped to ``file`` (stderr by default) and, when ``exit=True``, the
+    process is killed with a nonzero status for the scheduler to requeue."""
+
+    def __init__(self, timeout_s: float, *, exit: bool = True,
+                 file: Optional[TextIO] = None) -> None:
+        self.timeout_s = float(timeout_s)
+        self.exit = exit
+        self.file = file if file is not None else sys.stderr
+        self._armed = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.timeout_s > 0
+
+    def pet(self) -> None:
+        """Report liveness; (re)arms the deadline."""
+        if not self.enabled:
+            return
+        faulthandler.dump_traceback_later(
+            self.timeout_s, repeat=False, file=self.file, exit=self.exit)
+        self._armed = True
+
+    def stop(self) -> None:
+        """Disarm (end of training / controlled shutdown)."""
+        if self._armed:
+            faulthandler.cancel_dump_traceback_later()
+            self._armed = False
+
+    def __enter__(self) -> "Watchdog":
+        self.pet()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -41,10 +41,11 @@ def _base_optimizer(name: str, learning_rate) -> optax.GradientTransformation:
     if name == "lamb":
         return optax.lamb(learning_rate)
     if name == "lbfgs":
-        raise NotImplementedError(
-            "lbfgs requires a line-search driver incompatible with the "
-            "jitted train step; reference lists it (main.py:317) but never "
-            "exercises it for BYOL")
+        # Memory-limited BFGS direction with the schedule LR.  The torch
+        # closure/zoom-line-search driver (reference main.py:317) cannot run
+        # inside a jitted step; the direction update itself is jit-native.
+        return optax.chain(optax.scale_by_lbfgs(),
+                           optax.scale_by_learning_rate(learning_rate))
     raise ValueError(f"unknown optimizer {name!r}")
 
 

@@ -102,16 +102,17 @@ def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array
     tx, schedule = build_tx(rcfg)
     scfg = step_config(rcfg)
 
+    from byol_tpu.core.rng import split_named
+    keys = split_named(rng, ("params", "weight_init"))
     with mesh:
         variables = init_variables(
-            net, rcfg, rng, batch=max(2, mesh.shape[DATA_AXIS]))
+            net, rcfg, keys["params"], batch=max(2, mesh.shape[DATA_AXIS]))
         if cfg.model.weight_initialization:
             # --weight-initialization scheme re-draw (main.py:436 analog)
             from byol_tpu.models.init import apply_weight_init
-            init_rng = jax.random.fold_in(rng, 1)
             variables = dict(variables)
             variables["params"] = apply_weight_init(
-                variables["params"], init_rng,
+                variables["params"], keys["weight_init"],
                 cfg.model.weight_initialization)
         state = create_train_state(
             variables, tx,

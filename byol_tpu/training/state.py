@@ -51,7 +51,7 @@ def create_train_state(variables: Any, tx: optax.GradientTransformation,
         ema_step = jnp.ones((), jnp.int32)
     else:
         raise ValueError(f"unknown ema_init_mode {ema_init_mode!r}")
-    return TrainState(
+    state = TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
         batch_stats=batch_stats,
@@ -61,3 +61,25 @@ def create_train_state(variables: Any, tx: optax.GradientTransformation,
         polyak_params=(jax.tree_util.tree_map(jnp.array, params)
                        if polyak_ema > 0.0 else None),
     )
+    return _dedupe_buffers(state)
+
+
+def _dedupe_buffers(state: TrainState) -> TrainState:
+    """Copy any leaf that aliases an earlier leaf's buffer.
+
+    Some optimizer inits store the PARAM ARRAYS THEMSELVES in their state
+    (optax.scale_by_lbfgs keeps the previous-params tree as the very objects
+    passed in), so the flattened TrainState would contain one buffer twice —
+    and the train step's ``donate_argnums=(0,)`` then fails with "Attempt to
+    donate the same buffer twice".  A one-time copy at setup breaks the
+    aliasing."""
+    seen: set = set()
+
+    def uniq(x):
+        if isinstance(x, jax.Array):
+            if id(x) in seen:
+                return jnp.array(x)
+            seen.add(id(x))
+        return x
+
+    return jax.tree_util.tree_map(uniq, state)

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -115,9 +117,9 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                    output_size=loader.output_size,
                    input_shape=loader.input_shape)
 
-    rng = jax.random.PRNGKey(cfg.device.seed)
+    from byol_tpu.core.rng import root_key
     net, state, train_step, eval_step, schedule = setup_training(
-        rcfg, mesh, rng)
+        rcfg, mesh, root_key(cfg.device.seed))
     if verbose:
         from byol_tpu.utils import number_of_parameters
         print(f"model: {cfg.model.arch}, "
@@ -165,15 +167,30 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         return FitResult(state=state, epoch=init_epoch - 1, train_metrics={},
                          test_metrics=test_metrics, stopped_early=True,
                          images_per_sec_per_chip=0.0)
+    resume_skip = 0
     if saver.has_checkpoint():
         # Plain resume continues from the LAST checkpoint — restoring BEST
         # here would silently discard all post-best training and reset the
         # persisted patience counter on every relaunch.  Best-restore is
         # reserved for the early-stop terminal path (main.py:767-769).
         state, init_epoch = saver.restore(state, best=False)
+        if not cfg.device.debug_step:
+            # A preemption checkpoint (save-on-SIGTERM) lands mid-epoch: the
+            # step counter is then not a multiple of steps_per_epoch.  Data
+            # order is deterministic per (seed, epoch), so resume EXACTLY:
+            # re-enter the interrupted epoch and skip the batches its saved
+            # steps already consumed.  (debug_step runs one batch per epoch
+            # regardless, so the counter arithmetic doesn't apply there.)
+            done_in_epoch = int(state.step) % rcfg.steps_per_train_epoch
+            if done_in_epoch:
+                init_epoch -= 1
+                resume_skip = done_in_epoch
         if verbose:
             print(f"resumed from epoch {init_epoch - 1} "
-                  f"(best loss {saver.best_metric})")
+                  f"(best loss {saver.best_metric}"
+                  + (f", re-entering epoch {init_epoch} at batch "
+                     f"{resume_skip}" if resume_skip else "") + ")")
+    resume_epoch = init_epoch
 
     timer = StepTimer(rcfg.global_batch_size, n_devices)
     train_metrics: Dict[str, float] = {}
@@ -182,16 +199,53 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     first_batch_checked = False
     epoch = init_epoch
 
+    # Preemption notice (SIGTERM on TPU pods / SLURM) -> checkpoint NOW and
+    # exit 143 so the scheduler requeues and the relaunch resumes from LAST
+    # (§5.3; the reference loses everything since its last best-save).
+    preempted = threading.Event()
+    old_sigterm = None
+    if cfg.device.save_on_signal:
+        try:
+            old_sigterm = signal.signal(
+                signal.SIGTERM, lambda signum, frame: preempted.set())
+        except ValueError:   # not the main thread (e.g. test runner worker)
+            old_sigterm = None
+
+    def _maybe_preempt_save():
+        if not preempted.is_set():
+            return
+        # epoch is partially trained: persist it as LAST (never best).  The
+        # step/EMA counters are exact; the relaunch detects the mid-epoch
+        # counter (step % steps_per_epoch != 0), re-enters this epoch and
+        # skips the batches already trained — an exact resume.
+        saver.store.save(epoch, state, is_best=False)
+        saver.store._ckptr.wait_until_finished()
+        print(f"SIGTERM: checkpointed epoch {epoch} at step "
+              f"{int(state.step)}; exiting 143 for requeue")
+        raise SystemExit(143)
+
+    # Hung-collective watchdog (§5.2): a lost host shows up as an epoch
+    # readback that never returns; dump stacks + die so the job requeues
+    # instead of hanging forever.
+    from byol_tpu.observability.watchdog import Watchdog
+    watchdog = Watchdog(cfg.device.watchdog_timeout)
+
     for epoch in range(init_epoch, cfg.task.epochs):
         # ---- train (execute_graph prefix='train', main.py:665-677) -------
         loader.set_all_epochs(epoch)
         acc = MetricAccumulator()
         t0 = time.time()
         sample_batch = None
+        watchdog.pet()
 
         def tapped_batches():
             nonlocal first_batch_checked, sample_batch
-            for batch in loader.train_loader:
+            # exact mid-epoch resume: drop the leading batches the preempted
+            # run already trained (deterministic order per (seed, epoch))
+            skip = resume_skip if epoch == resume_epoch else 0
+            for i, batch in enumerate(loader.train_loader):
+                if i < skip:
+                    continue
                 if not first_batch_checked:
                     _range_check(batch)
                     first_batch_checked = True
@@ -204,6 +258,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         for dev_batch in prefetch_to_mesh(tapped_batches(), mesh):
             state, metrics = train_step(state, dev_batch)
             acc.update(metrics)  # device-side running sum; no host sync
+            _maybe_preempt_save()
             if cfg.device.fault_at_step and \
                     int(state.step) == cfg.device.fault_at_step:
                 # fault injection (§5.3): die mid-epoch like a preempted pod
@@ -219,6 +274,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         # the throughput derived from it) is honest (StepTimer docstring).
         train_elapsed = time.time() - t0
         timer.record_epoch(acc.count, train_elapsed)
+        watchdog.pet()  # readback returned: the collectives are alive
         if verbose:
             print(epoch_log_line("train", epoch,
                                  acc.count * rcfg.global_batch_size,
@@ -249,9 +305,13 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                 {"aug1_imgs": sample_batch["view1"],
                  "aug2_imgs": sample_batch["view2"]}, epoch, prefix="train")
         if epoch == 2:
-            # config + scheduler/cluster identity posted once (main.py:773-779)
-            from byol_tpu.utils import get_slurm_id, get_tpu_env
-            meta = {"slurm_id": get_slurm_id(), "tpu": get_tpu_env()}
+            # config + cluster identity posted once (main.py:773-779; the
+            # reference also stamps the AWS instance id, main.py:128-130)
+            from byol_tpu.utils import (get_aws_instance_id, get_slurm_id,
+                                        get_tpu_env)
+            meta = {"slurm_id": get_slurm_id(),
+                    "aws_instance_id": get_aws_instance_id(),
+                    "tpu": get_tpu_env()}
             grapher.add_text("config", cfg.to_json() + "\n" + str(meta),
                              epoch)
         grapher.save()
@@ -267,6 +327,9 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                       f"(loss {saver.best_metric:.4f})")
             break
 
+    watchdog.stop()
+    if old_sigterm is not None:
+        signal.signal(signal.SIGTERM, old_sigterm)
     saver.close()
     grapher.close()
     return FitResult(state=state, epoch=epoch, train_metrics=train_metrics,

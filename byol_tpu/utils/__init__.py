@@ -2,13 +2,12 @@
 
 Call-site-for-call-site equivalents of the reference's helpers submodule
 surface: run-metadata introspection (AWS instance id main.py:128-130, SLURM
-id main.py:775-777), parameter counting (main.py:447-449), and the no-op
-context manager (main.py:584).  ``number_of_gpus``/launch topology
-(main.py:800-801) has no analog — JAX owns device enumeration.
+id main.py:775-777) and parameter counting (main.py:447-449).
+``number_of_gpus``/launch topology (main.py:800-801) has no analog — JAX
+owns device enumeration.
 """
 from __future__ import annotations
 
-import contextlib
 import os
 from typing import Any, Optional
 
@@ -51,9 +50,6 @@ def number_of_parameters(params: Any) -> int:
                for p in jax.tree_util.tree_leaves(params)
                if hasattr(p, "shape"))
 
-
-@contextlib.contextmanager
-def dummy_context():
-    """No-op context manager (the train-mode branch of the reference's
-    no_grad switch, main.py:584 — vestigial in JAX, kept for API parity)."""
-    yield
+# (``helpers.utils.dummy_context`` — the train-mode branch of the reference's
+# no_grad switch, main.py:584 — has no JAX analog: there is no grad mode to
+# toggle, so the symbol is deliberately not provided.)

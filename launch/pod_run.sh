@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# One-command TPU pod launch — the TPU-native analog of docker/run.sh:1-39
+# (which pinned GPUs, mounted datasets/models, and ran one container per
+# node) and of the manual multi-node recipe in the reference README:37-77.
+#
+# Usage:
+#   ./launch/pod_run.sh <tpu-name> <zone> "<train.py args>"
+# Example (v4-64 pod, ImageNet, the BASELINE.json headline config):
+#   ./launch/pod_run.sh byol-v4-64 us-central2-b \
+#       "--task image_folder --data-dir /datasets/imagenet \
+#        --batch-size 4096 --epochs 100 --arch resnet50 --fuse-views --half"
+#
+# Semantics: runs ONE process per TPU-VM host (--worker=all), the topology
+# this framework is built for (byol_tpu/cli.py).  JAX discovers the pod's
+# coordinator + process identity from TPU metadata, so no --distributed-*
+# flags are needed on Cloud TPU; they exist for non-GCP clusters
+# (launch/slurm_run.sh).
+set -euo pipefail
+
+TPU_NAME=${1:?usage: pod_run.sh <tpu-name> <zone> "<args>"}
+ZONE=${2:?usage: pod_run.sh <tpu-name> <zone> "<args>"}
+ARGS=${3:-"--task fake --debug-step --batch-size 256 --epochs 1"}
+REPO_DIR=${REPO_DIR:-"$(cd "$(dirname "$0")/.." && pwd)"}
+REMOTE_DIR=${REMOTE_DIR:-"~/byol_tpu_run"}
+
+# 1) ship the repo to every worker (rsync over gcloud ssh; the docker/run.sh
+#    analog mounted the repo instead — on TPU VMs a copy is simpler and
+#    avoids NFS on the pod)
+gcloud compute tpus tpu-vm scp --recurse --worker=all --zone="$ZONE" \
+    "$REPO_DIR" "$TPU_NAME":"$REMOTE_DIR"
+
+# 2) install once per worker (idempotent), then launch one process per host.
+#    $HOME/datasets and $HOME/models mirror the reference's volume contract.
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone="$ZONE" --worker=all \
+    --command="
+set -e
+cd $REMOTE_DIR
+pip install -q -e .[tpu] -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+mkdir -p \$HOME/datasets \$HOME/models
+nohup python train.py $ARGS \
+    --model-dir \$HOME/models --data-dir \$HOME/datasets \
+    > train_\$(hostname).log 2>&1 &
+echo launched on \$(hostname)
+"
+echo "pod launch dispatched; tail logs with:"
+echo "  gcloud compute tpus tpu-vm ssh $TPU_NAME --zone=$ZONE --worker=0 \\"
+echo "      --command='tail -f $REMOTE_DIR/train_*.log'"

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# SLURM launch — the scheduler-equivalent of the reference's slurm/run.sh:1-49
+# (16-task job array, 1 GPU per task, rank = SLURM_ARRAY_TASK_ID, master
+# discovered by grepping squeue).  TPU-native deltas:
+#   - one task per HOST, not per chip: each process drives all local devices
+#     through one SPMD program (byol_tpu/cli.py topology);
+#   - coordinator = first node of the allocation via scontrol (deterministic,
+#     vs the reference's squeue text-scrape, slurm/run.sh:45-47);
+#   - explicit rendezvous via --distributed-master/--num-processes/
+#     --distributed-rank (jax.distributed.initialize under the hood) for
+#     clusters without TPU pod metadata.
+#
+#SBATCH --job-name=byol_tpu
+#SBATCH --nodes=16
+#SBATCH --ntasks-per-node=1
+#SBATCH --cpus-per-task=16
+#SBATCH --time=72:00:00
+#SBATCH --output=byol_tpu_%j_%t.log
+set -euo pipefail
+
+# Reference scale: global batch 1024 over 16 hosts, 100 epochs
+# (slurm/run.sh:6-9,40-44).
+ARGS=${ARGS:-"--task image_folder --data-dir $HOME/datasets/imagenet \
+  --batch-size 1024 --epochs 100 --arch resnet50 --half --fuse-views \
+  --uid slurm_${SLURM_JOB_ID:-0}"}
+PORT=${PORT:-29300}
+
+MASTER=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1)
+
+srun --kill-on-bad-exit=1 bash -c "
+python train.py $ARGS \
+  --distributed-master ${MASTER}:${PORT} \
+  --num-processes \$SLURM_NTASKS \
+  --distributed-rank \$SLURM_PROCID \
+  --model-dir \$HOME/models
+"

@@ -62,6 +62,18 @@ def test_flash_bf16():
                                rtol=2e-2, atol=2e-2)
 
 
+def test_flash_long_sequence_streams_kv():
+    """S=4096 with 128-blocks: 32 K tiles walked on the grid.  At the old
+    whole-K-resident layout this shape held the full padded K/V per program;
+    the grid-streamed kernel must still match the dense oracle exactly
+    (round-2 verdict: VMEM residency capped usable sequence length)."""
+    from byol_tpu.ops.flash_attention import flash_attention
+    q, k, v = _qkv(jax.random.PRNGKey(8), b=1, h=1, s=4096, d=8)
+    out = flash_attention(q, k, v, block_q=128, block_k=128, interpret=True)
+    np.testing.assert_allclose(out, dense_attention(q, k, v),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_ring_matches_dense_shard_map(mesh_dp_sp):
     """Ring attention over a real 2-way sequence axis (4 data x 2 sequence
     CPU mesh) must reproduce dense attention on the gathered sequence."""

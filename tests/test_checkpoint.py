@@ -41,6 +41,7 @@ def _batch(mesh, b=16, size=16, seed=0):
     return shard_batch_to_mesh(batch, mesh)
 
 
+@pytest.mark.slow
 def test_roundtrip_preserves_full_state(mesh8, tmp_path):
     _, (net, state, train_step, _, _) = _tiny_setup(mesh8, tmp_path)
     batch = _batch(mesh8)
@@ -66,6 +67,7 @@ def test_roundtrip_preserves_full_state(mesh8, tmp_path):
     store.close()
 
 
+@pytest.mark.slow
 def test_resume_continues_training(mesh8, tmp_path):
     """Restored state must be usable by the jitted step and keep counting."""
     _, (net, state, train_step, _, _) = _tiny_setup(mesh8, tmp_path)

@@ -81,6 +81,36 @@ def test_step_timer_rate():
     assert t.images_per_sec_per_chip() > 0.0
 
 
+def test_watchdog_dumps_stacks_on_stall(tmp_path):
+    """Armed watchdog with no pet() within the timeout must dump all thread
+    stacks to the file (the hung-collective diagnostic, SURVEY §5.2)."""
+    import time as time_mod
+    from byol_tpu.observability.watchdog import Watchdog
+    path = tmp_path / "wd.txt"
+    with open(path, "w") as f:
+        wd = Watchdog(0.3, exit=False, file=f)
+        wd.pet()
+        time_mod.sleep(1.0)   # stall past the deadline
+        wd.stop()
+    text = path.read_text()
+    assert "Timeout" in text and "Thread" in text
+
+
+def test_watchdog_disabled_and_petted_paths(tmp_path):
+    import time as time_mod
+    from byol_tpu.observability.watchdog import Watchdog
+    path = tmp_path / "wd2.txt"
+    with open(path, "w") as f:
+        wd = Watchdog(0.0, exit=False, file=f)   # disabled
+        wd.pet()
+        wd.stop()
+        wd = Watchdog(5.0, exit=False, file=f)   # petted in time
+        wd.pet()
+        time_mod.sleep(0.05)
+        wd.stop()
+    assert path.read_text() == ""
+
+
 def test_metric_accumulator_weighted_by_valid_count():
     """Eval metrics carry _weight (valid rows under pad+mask batching); the
     epoch mean must weight batches by it, and _weight must not leak out."""

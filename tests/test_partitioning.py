@@ -66,6 +66,7 @@ def test_dp_mesh_is_fully_replicated(mesh8):
     assert shardings["a"].spec == P()
 
 
+@pytest.mark.slow
 def test_tp_train_step_matches_dp():
     """Same seed, same batch: a dp x tp run must produce the same loss as
     pure dp (TP is a layout choice, not a numerics choice)."""
@@ -96,6 +97,7 @@ def test_tp_train_step_matches_dp():
     assert np.isfinite(float(m_tp["loss_mean"]))
 
 
+@pytest.mark.slow
 def test_tp_same_batch_matches_dp_numerics():
     """Identical global batch through dp-8 and dp4 x tp2: same loss."""
     devices = jax.devices()[:8]
@@ -113,6 +115,7 @@ def test_tp_same_batch_matches_dp_numerics():
                                float(m_tp["loss_mean"]), rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_sp_ring_vit_train_step(mesh_dp_sp):
     """Full BYOL train step with ring attention over the sequence axis."""
     from byol_tpu.models import registry

@@ -64,6 +64,7 @@ def mesh8_module():
 
 
 class TestTrainStep:
+    @pytest.mark.slow
     def test_loss_finite_and_decreasing(self, training, mesh8_module):
         rcfg, (net, state, train_step, eval_step, sched) = training
         state = fresh(state)
@@ -77,6 +78,7 @@ class TestTrainStep:
         # BYOL loss on repeated data should trend down.
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_ema_and_counters_move(self, training, mesh8_module):
         rcfg, (net, state, train_step, _, _) = training
         state = fresh(state)
@@ -127,6 +129,7 @@ class TestTrainStep:
 
 
 class TestShardingSemantics:
+    @pytest.mark.slow
     def test_global_batch_grads_match_single_device(self, mesh8_module):
         """The sharded step must produce the same result as an unsharded
         oracle on one device — DDP-allreduce + SyncBN equivalence
@@ -139,13 +142,17 @@ class TestShardingSemantics:
         sharded_state, sharded_metrics = train_step(state, batch)
 
         # Single-device oracle: same net/params, jit with no sharding.
+        # setup_training derives its init key via split_named (core/rng.py);
+        # the oracle must follow the same derivation to share parameters.
+        from byol_tpu.core.rng import split_named
         from byol_tpu.training.build import build_net, build_tx, step_config
         from byol_tpu.training.steps import make_train_step
         net1 = build_net(rcfg)
         tx1, _ = build_tx(rcfg)
-        variables = net1.init(jax.random.PRNGKey(0),
-                              jnp.zeros((2, 32, 32, 3)), train=True,
-                              method="warmup")
+        init_key = split_named(jax.random.PRNGKey(0),
+                               ("params", "weight_init"))["params"]
+        variables = net1.init(init_key, jnp.zeros((2, 32, 32, 3)),
+                              train=True, method="warmup")
         state1 = create_train_state(variables, tx1)
         step1 = jax.jit(make_train_step(net1, tx1, step_config(rcfg)))
         dev = jax.devices()[0]
@@ -159,6 +166,25 @@ class TestShardingSemantics:
         np.testing.assert_allclose(
             float(sharded_metrics["loss_mean"]),
             float(oracle_metrics["loss_mean"]), rtol=2e-4)
+
+
+class TestStateBuffers:
+    def test_optimizer_state_never_aliases_params(self):
+        """Optimizers like optax.scale_by_lbfgs store the param ARRAYS
+        themselves in their init state; the donated TrainState must not
+        contain one buffer twice or Execute() rejects the donation."""
+        import optax
+        params = {"w": jnp.ones((3,))}
+        aliasing_tx = optax.GradientTransformation(
+            init=lambda p: {"prev_params": p},     # aliases every param leaf
+            update=lambda g, s, p=None: (g, s))
+        st = create_train_state({"params": params}, aliasing_tx)
+        leaf_ids = [id(x) for x in jax.tree_util.tree_leaves(st)
+                    if isinstance(x, jax.Array)]
+        assert len(leaf_ids) == len(set(leaf_ids))
+        np.testing.assert_array_equal(
+            np.asarray(st.opt_state["prev_params"]["w"]),
+            np.asarray(st.params["w"]))
 
 
 class TestParityModes:

@@ -37,6 +37,7 @@ def _tiny_loader(cfg):
     return get_loader(cfg, num_fake_samples=32)
 
 
+@pytest.mark.slow
 def test_fit_end_to_end(tmp_path):
     cfg = _tiny_cfg(tmp_path)
     grapher = Grapher("jsonl", logdir=str(tmp_path / "runs"), run_name="t",
@@ -66,6 +67,7 @@ def test_fit_end_to_end(tmp_path):
                os.listdir(tmp_path / "models" / runs[0]))
 
 
+@pytest.mark.slow
 def test_fit_resume_continues_epochs(tmp_path):
     # debug_step keeps each epoch to one minibatch so the test exercises the
     # resume path, not the hot loop.
@@ -79,6 +81,7 @@ def test_fit_resume_continues_epochs(tmp_path):
     assert int(r2.state.step) >= int(r1.state.step)
 
 
+@pytest.mark.slow
 def test_fit_debug_step(tmp_path):
     cfg = _tiny_cfg(tmp_path,
                     device=DeviceConfig(num_replicas=8, half=False, seed=7,
@@ -87,6 +90,7 @@ def test_fit_debug_step(tmp_path):
     assert int(result.state.step) == 2  # one minibatch per epoch x 2 epochs
 
 
+@pytest.mark.slow
 def test_fault_injection_then_resume(tmp_path):
     """--fault-at-step kills mid-run; a relaunch resumes from the last
     checkpoint and completes (the preemption drill of SURVEY.md §5.3 that
@@ -108,6 +112,44 @@ def test_fault_injection_then_resume(tmp_path):
     assert np.isfinite(result.test_metrics["loss_mean"])
 
 
+@pytest.mark.slow
+def test_sigterm_preemption_saves_and_resumes(tmp_path):
+    """A SIGTERM (pod preemption notice) mid-epoch must checkpoint the live
+    state, exit 143, and leave a resumable run (SURVEY §5.3; the reference
+    loses all progress since its last best-save)."""
+    import signal as signal_mod
+    from byol_tpu.data.loader import LoaderBundle
+    cfg = _tiny_cfg(tmp_path, task=TaskConfig(
+        task="fake", batch_size=16, epochs=2, image_size_override=16,
+        log_dir=str(tmp_path / "runs"), uid="sig"))
+    base = _tiny_loader(cfg)
+
+    def sig_train_iter(epoch):
+        it = base.make_train_iter(epoch)
+        yield next(it)
+        signal_mod.raise_signal(signal_mod.SIGTERM)   # preemption notice
+        yield next(it)
+
+    loader = LoaderBundle(make_train_iter=sig_train_iter,
+                          make_test_iter=base.make_test_iter,
+                          input_shape=base.input_shape,
+                          num_train_samples=base.num_train_samples,
+                          num_test_samples=base.num_test_samples,
+                          output_size=base.output_size)
+    with pytest.raises(SystemExit) as exc_info:
+        fit(cfg, loader=loader, verbose=False)
+    assert exc_info.value.code == 143
+    # a checkpoint was written and a clean relaunch resumes + completes.
+    # Resume is EXACT: SIGTERM hit after step 1 of epoch 0 (2 steps/epoch),
+    # so the relaunch re-enters epoch 0 skipping 1 batch and finishes with
+    # precisely epochs * steps_per_epoch optimizer steps.
+    result = fit(cfg, loader=_tiny_loader(cfg), verbose=False)
+    assert result.epoch == 1
+    assert int(result.state.step) == 2 * 2
+    assert np.isfinite(result.test_metrics["loss_mean"])
+
+
+@pytest.mark.slow
 def test_fit_eval_remainder_batches(tmp_path):
     """A test set whose size divides by neither the batch size nor the
     8-device data axis (21 = 16 + 5) must work: eval pads the short batch to
@@ -162,6 +204,11 @@ def test_cli_parser_reference_surface(tmp_path):
     assert args.lr == 0.2 and args.optimizer == "lars_momentum"
     assert args.arch == "resnet50" and args.base_decay == 0.996
     assert args.warmup == 10 and args.weight_decay == 1e-6
+
+    # --num-processes (host process count) is distinct from --num-replicas
+    # (device-axis size): hosts driving several chips have different values.
+    args = build_parser().parse_args([])
+    assert args.num_processes == 0   # auto-detect from pod metadata
 
     args = build_parser().parse_args([
         "--task", "fake", "--batch-size", "16", "--epochs", "1",
