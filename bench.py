@@ -208,14 +208,14 @@ def main():
             "no batch size fit in memory for the primary config; "
             f"per-candidate tracebacks above, partial log in {_PARTIAL_PATH}")
 
+    mfu = mfu_of(value)
     print(json.dumps({
         "metric": f"{arch}_byol_train_images_per_sec_per_chip",
         "value": round(value, 2),
         "unit": "images/sec/chip",
         "vs_baseline": (round(value / baseline, 3)
                         if baseline is not None else None),
-        "mfu": (round(mfu_of(value), 4)
-                if mfu_of(value) is not None else None),
+        "mfu": round(mfu, 4) if mfu is not None else None,
     }))
 
 

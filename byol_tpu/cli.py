@@ -110,6 +110,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tensor-parallel axis size")
     x.add_argument("--sequence-parallel", type=int, default=1,
                    help="sequence/context-parallel axis size (ViT)")
+    x.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-style weight-update sharding: shard the "
+                        "optimizer/EMA/Polyak trees over the data axis "
+                        "(~Nx less aux-state HBM per chip)")
     x.add_argument("--fuse-views", action="store_true",
                    help="one fused encoder call for both views (perf; "
                         "changes BN batch statistics vs the reference)")
@@ -133,6 +137,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("step", "epoch"), help="Quirk Q5 switch")
     x.add_argument("--profile-port", type=int, default=0,
                    help="start jax.profiler server on this port (0=off)")
+    x.add_argument("--linear-eval", action="store_true",
+                   help="after training, run the OFFLINE linear-evaluation "
+                        "protocol (frozen encoder + fresh probe — the BYOL "
+                        "paper's metric; the in-training probe is the "
+                        "reference's concurrent metric, main.py:249-252)")
     return p
 
 
@@ -182,7 +191,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             watchdog_timeout=args.watchdog_timeout,
             shard_eval=args.shard_eval,
             model_parallel=args.model_parallel,
-            sequence_parallel=args.sequence_parallel),
+            sequence_parallel=args.sequence_parallel,
+            fsdp=args.fsdp),
         parity=ParityConfig(
             loss_norm_mode=args.loss_norm_mode,
             ema_init_mode=args.ema_init_mode,
@@ -216,11 +226,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.profile_port:
         from byol_tpu.observability import profiling
         profiling.start_server(args.profile_port)
+    from byol_tpu.data.loader import get_loader
     from byol_tpu.training.trainer import fit
-    result = fit(cfg)
+    # one loader serves both training and the optional linear eval — at
+    # ImageNet scale building it twice doubles the startup scan/IO
+    loader = get_loader(cfg, shard_eval=cfg.device.shard_eval)
+    result = fit(cfg, loader=loader)
     print(f"done: epoch {result.epoch}, test loss "
           f"{result.test_metrics.get('loss_mean', float('nan')):.4f}, "
           f"{result.images_per_sec_per_chip:.1f} images/sec/chip")
+    if args.linear_eval:
+        import jax
+        if jax.process_count() > 1:
+            # the extractor jit closes over pod-global state while batches
+            # are host-local (linear_eval.py module docstring) — run the
+            # protocol single-host on the saved checkpoint instead
+            print("linear_eval: skipped on multi-host runs; restore the "
+                  "checkpoint on one host and re-run with --linear-eval")
+        else:
+            from byol_tpu.training.linear_eval import run_linear_eval_from_cfg
+            le = run_linear_eval_from_cfg(cfg, result.state, loader=loader,
+                                          seed=cfg.device.seed)
+            print(f"linear_eval(offline): top1 {le.top1:.2f} "
+                  f"top5 {le.top5:.2f} (train acc {le.train_acc:.2f}, "
+                  f"{le.num_train} train / {le.num_test} test)")
     return 0
 
 

@@ -119,6 +119,10 @@ class DeviceConfig:
     # TPU-native mesh shape: data x model x sequence. model/sequence default 1.
     model_parallel: int = 1
     sequence_parallel: int = 1
+    fsdp: bool = False                  # ZeRO-style weight-update sharding:
+                                        # optimizer/EMA/Polyak trees sharded
+                                        # over the data axis (params stay
+                                        # replicated for the forward)
 
 
 @_frozen

@@ -160,4 +160,5 @@ def image_folder_loader(cfg: Config, *, host_batch: int,
         num_train_samples=n_train,
         num_test_samples=n_test,
         output_size=len(classes),
+        make_train_eval_iter=make_iter(*tr_sh, train=False),
     )

@@ -48,6 +48,10 @@ class LoaderBundle:
     num_test_samples: int
     output_size: int
     epoch: int = 0
+    # TRAIN split under the EVAL transform (resize-only, unshuffled) — what
+    # the offline linear-eval protocol trains its probe on (training/
+    # linear_eval.py).  Optional: None for hand-built test bundles.
+    make_train_eval_iter: Optional[Callable[[int], Iterator[Batch]]] = None
 
     def set_all_epochs(self, epoch: int) -> None:
         self.epoch = epoch
@@ -59,6 +63,13 @@ class LoaderBundle:
     @property
     def test_loader(self) -> Iterator[Batch]:
         return self.make_test_iter(self.epoch)
+
+    @property
+    def train_eval_loader(self) -> Iterator[Batch]:
+        if self.make_train_eval_iter is None:
+            raise ValueError("this LoaderBundle provides no train-eval "
+                             "(resize-only train split) iterator")
+        return self.make_train_eval_iter(self.epoch)
 
 
 def _process_info() -> Tuple[int, int]:
@@ -189,6 +200,7 @@ def _device_pipeline(images: np.ndarray, labels: np.ndarray, *,
 
 
 def get_loader(cfg: Config, *, num_fake_samples: int = 512,
+               num_synth_samples: int = 20_000,
                shard_eval: bool = False) -> LoaderBundle:
     """Dispatch on ``cfg.task.task``; see module docstring for the contract.
 
@@ -227,10 +239,11 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
         # learnable procedural dataset (readers.load_synth) — the offline
         # stand-in for CIFAR-scale learning-dynamics evidence
         size = cfg.task.image_size_override or 32
-        x_tr, y_tr = readers.load_synth(20_000, size, seed=cfg.device.seed,
-                                        train=True)
-        x_te, y_te = readers.load_synth(max(2_000, host_batch), size,
-                                        seed=cfg.device.seed, train=False)
+        x_tr, y_tr = readers.load_synth(num_synth_samples, size,
+                                        seed=cfg.device.seed, train=True)
+        x_te, y_te = readers.load_synth(
+            max(num_synth_samples // 10, host_batch), size,
+            seed=cfg.device.seed, train=False)
         n_classes = 10
     elif task in readers.ARRAY_LOADERS:
         fn, n_classes = readers.ARRAY_LOADERS[task]
@@ -279,6 +292,10 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
         make_test_iter=test_pipeline(
             x_te, y_te, batch_size=host_batch, image_size=size, train=False,
             color_jitter_strength=cj, seed=cfg.device.seed, shuffle=False),
+        make_train_eval_iter=test_pipeline(
+            x_trs, y_trs, batch_size=host_batch, image_size=size,
+            train=False, color_jitter_strength=cj, seed=cfg.device.seed,
+            shuffle=False),
         input_shape=(size, size, 3),
         num_train_samples=n_train,
         num_test_samples=n_test,

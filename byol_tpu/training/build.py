@@ -125,7 +125,7 @@ def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array
     # model); TP rules shard the MLP-head params/EMA/opt-state over the
     # 'model' axis when it is >1 (parallel/partitioning.py).
     from byol_tpu.parallel.partitioning import state_shardings
-    state_sh = state_shardings(state, mesh)
+    state_sh = state_shardings(state, mesh, fsdp=cfg.device.fsdp)
     state = jax.device_put(state, state_sh)
 
     train_step = jax.jit(

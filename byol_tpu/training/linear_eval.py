@@ -1,0 +1,187 @@
+"""Offline linear evaluation — the BYOL paper's protocol.
+
+The reference only measures its CONCURRENT probe (trained alongside BYOL on
+detached features, /root/reference/main.py:249-252,596-598, on Resize-only
+un-normalized test images).  The paper's headline numbers (66.5% top-1 @
+100ep — BASELINE.md) use the standard offline protocol instead: freeze the
+encoder, train a fresh linear classifier on its features, report top-1/5.
+BASELINE.md asks the rebuild to report BOTH; this module is the offline
+half.
+
+TPU-native design: features for the whole dataset are extracted once with
+the jitted frozen encoder (bf16 compute as trained, fp32 features out) and
+held in HOST memory; the classifier trains with minibatch multinomial
+logistic regression, streaming feature batches to the device (at ImageNet
+scale the feature matrix is ~10 GB — it must not live in HBM).  Probe FLOPs
+are trivial next to extraction.
+
+Single-process only: the extractor jit closes over the training state as
+placed by ``fit()``, which on a pod spans all hosts' devices while each
+host's loader yields different local data — gate callers on
+``jax.process_count() == 1`` (cli.py does).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from byol_tpu.objectives.metrics import topk_accuracy
+
+
+@dataclasses.dataclass
+class LinearEvalResult:
+    top1: float
+    top5: float
+    train_acc: float
+    num_train: int
+    num_test: int
+
+
+def extract_features(apply_fn: Callable, batches: Iterator[Dict[str, Any]],
+                     *, view: str = "view1") -> Tuple[np.ndarray, np.ndarray]:
+    """Run the frozen encoder over a loader; returns (features, labels).
+
+    ``apply_fn(images) -> representations`` must be jitted by the caller
+    (one compile; batches share the loader's fixed shape except a possible
+    final remainder, which is padded here to reuse the executable)."""
+    feats, labels = [], []
+    fixed = None
+    for batch in batches:
+        x = np.asarray(batch[view])
+        y = np.asarray(batch["label"])
+        n = len(y)
+        if fixed is None:
+            fixed = n
+        if n < fixed:                      # pad the remainder batch
+            pad = np.zeros((fixed - n,) + x.shape[1:], x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        f = np.asarray(apply_fn(x))[:n]
+        feats.append(f.astype(np.float32))
+        labels.append(y)
+    return np.concatenate(feats), np.concatenate(labels)
+
+
+def train_linear_probe(train_x: np.ndarray, train_y: np.ndarray,
+                       num_classes: int, *, epochs: int = 30,
+                       batch_size: int = 1024, lr: float = 0.1,
+                       weight_decay: float = 0.0, seed: int = 0
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Multinomial logistic regression on frozen features; returns (W, b).
+
+    Momentum + cosine decay, features standardized by train statistics —
+    the standard linear-eval recipe.  Features stay in HOST memory and are
+    streamed to the device one minibatch at a time: at ImageNet scale the
+    train features are ~10 GB fp32 (1.28M x 2048), which must not be
+    materialized in HBM next to the matmul workspace."""
+    n, d = train_x.shape
+    batch_size = min(batch_size, n)
+    steps_per_epoch = max(n // batch_size, 1)
+
+    mu = train_x.mean(0, keepdims=True).astype(np.float32)
+    sd = (train_x.std(0, keepdims=True) + 1e-6).astype(np.float32)
+    mu_d, sd_d = jnp.asarray(mu), jnp.asarray(sd)    # (1, d) — tiny
+
+    schedule = optax.cosine_decay_schedule(lr, epochs * steps_per_epoch)
+    tx = optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.sgd(schedule, momentum=0.9))
+    params = {"w": jnp.zeros((d, num_classes), jnp.float32),
+              "b": jnp.zeros((num_classes,), jnp.float32)}
+    opt_state = tx.init(params)
+
+    def loss_fn(p, xb, yb):
+        logits = ((xb - mu_d) / sd_d) @ p["w"] + p["b"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb).mean()
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        grads = jax.grad(loss_fn)(params, xb, yb)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    rng = np.random.RandomState(seed)
+    ys = train_y.astype(np.int32)
+    for _ in range(epochs):
+        perm = rng.permutation(n)
+        for s in range(steps_per_epoch):
+            idx = perm[s * batch_size:(s + 1) * batch_size]
+            params, opt_state = step(params, opt_state,
+                                     train_x[idx], ys[idx])
+
+    # fold the standardization into (W, b) so callers apply raw features
+    w = np.asarray(params["w"]) / sd.T
+    b = np.asarray(params["b"]) - (mu / sd) @ np.asarray(params["w"])
+    return w, b.reshape(-1)
+
+
+def linear_eval(apply_fn: Callable, train_batches: Iterator,
+                test_batches: Iterator, num_classes: int, *,
+                epochs: int = 30, lr: float = 0.1, seed: int = 0
+                ) -> LinearEvalResult:
+    """Full offline protocol: extract -> fit probe -> report top-1/5."""
+    train_x, train_y = extract_features(apply_fn, train_batches)
+    test_x, test_y = extract_features(apply_fn, test_batches)
+    w, b = train_linear_probe(train_x, train_y, num_classes,
+                              epochs=epochs, lr=lr, seed=seed)
+
+    def acc(x, y, chunk: int = 8192):
+        """Chunked scoring: never materializes the full (N, classes) logits
+        (5+ GB at ImageNet scale) on device."""
+        wd, bd = jnp.asarray(w), jnp.asarray(b)
+        hits1 = hits5 = total = 0.0
+        for lo in range(0, len(y), chunk):
+            logits = jnp.asarray(x[lo:lo + chunk]) @ wd + bd
+            yb = jnp.asarray(y[lo:lo + chunk].astype(np.int32))
+            t1, t5 = topk_accuracy(logits, yb)
+            m = len(yb)
+            hits1 += float(t1) * m
+            hits5 += float(t5) * m
+            total += m
+        return hits1 / total, hits5 / total
+
+    top1, top5 = acc(test_x, test_y)
+    train_top1, _ = acc(train_x, train_y)
+    return LinearEvalResult(top1=top1, top5=top5, train_acc=train_top1,
+                            num_train=len(train_y), num_test=len(test_y))
+
+
+def encoder_apply_fn(net, state, *, half: bool = False) -> Callable:
+    """Jitted frozen-encoder feature extractor from a TrainState."""
+    from byol_tpu.core.precision import get_policy
+    policy = get_policy(half)
+
+    @jax.jit
+    def apply(x):
+        out = net.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            policy.cast_to_compute(x), train=False, mutable=False)
+        return out["representation"].astype(jnp.float32)
+
+    return apply
+
+
+def run_linear_eval_from_cfg(cfg, state, *, loader=None, epochs: int = 30,
+                             seed: int = 0) -> LinearEvalResult:
+    """Convenience driver: rebuild the encoder from ``cfg``, extract
+    resize-only features for the train/test splits, fit + score the probe."""
+    from byol_tpu.core.config import resolve
+    from byol_tpu.data.loader import get_loader
+    from byol_tpu.training.build import build_net
+
+    if loader is None:
+        loader = get_loader(cfg)
+    rcfg = resolve(cfg, num_train_samples=loader.num_train_samples,
+                   num_test_samples=loader.num_test_samples,
+                   output_size=loader.output_size,
+                   input_shape=loader.input_shape)
+    net = build_net(rcfg)
+    apply_fn = encoder_apply_fn(net, state, half=cfg.device.half)
+    return linear_eval(apply_fn, loader.train_eval_loader,
+                       loader.test_loader, loader.output_size,
+                       epochs=epochs, seed=seed)

@@ -275,6 +275,10 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         train_elapsed = time.time() - t0
         timer.record_epoch(acc.count, train_elapsed)
         watchdog.pet()  # readback returned: the collectives are alive
+        # the readback/eval/checkpoint windows dominate the epoch's
+        # wall-clock — a preemption notice landing there must not wait for
+        # the next epoch's batch loop (the grace period would expire first)
+        _maybe_preempt_save()
         if verbose:
             print(epoch_log_line("train", epoch,
                                  acc.count * rcfg.global_batch_size,
@@ -284,6 +288,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         t0 = time.time()
         acc = run_eval(state)
         test_metrics = {k: float(v) for k, v in acc.result().items()}
+        _maybe_preempt_save()
         if verbose:
             # total_weight = exact valid rows (pad rows excluded)
             n_eval = acc.total_weight()

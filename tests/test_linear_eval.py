@@ -1,0 +1,65 @@
+"""Offline linear-evaluation protocol (training/linear_eval.py) — the BYOL
+paper's metric, complementing the reference's concurrent probe
+(main.py:249-252; BASELINE.md asks for both)."""
+import numpy as np
+
+from byol_tpu.training.linear_eval import (extract_features, linear_eval,
+                                           train_linear_probe)
+
+
+def _blobs(n, d=16, classes=4, seed=0, spread=4.0):
+    centers = np.random.RandomState(42).randn(classes, d) * spread
+    rng = np.random.RandomState(seed)        # samples vary, centers fixed
+    y = rng.randint(0, classes, size=(n,))
+    x = centers[y] + rng.randn(n, d)
+    return x.astype(np.float32), y.astype(np.int64)
+
+
+def test_probe_separates_gaussian_blobs():
+    x, y = _blobs(800)
+    xt, yt = _blobs(200, seed=1)
+    w, b = train_linear_probe(x, y, num_classes=4, epochs=10, lr=0.5)
+    acc = (np.argmax(xt @ w + b, axis=1) == yt).mean()
+    assert acc > 0.95
+
+
+def test_extract_features_pads_remainder_batch():
+    """A final short batch must be padded to the compiled shape and the pad
+    rows sliced away — features/labels line up exactly."""
+    calls = []
+
+    def apply_fn(x):
+        calls.append(x.shape)
+        return x.reshape(len(x), -1)[:, :4] * 2.0
+
+    def batches():
+        rng = np.random.RandomState(0)
+        for n in (8, 8, 3):                       # 19 samples, remainder 3
+            yield {"view1": rng.rand(n, 2, 2, 3).astype(np.float32),
+                   "view2": None,
+                   "label": np.arange(n).astype(np.int32)}
+
+    feats, labels = extract_features(apply_fn, batches())
+    assert feats.shape == (19, 4) and labels.shape == (19,)
+    assert all(s[0] == 8 for s in calls)          # one static batch shape
+
+
+def test_linear_eval_end_to_end_on_features():
+    """Identity encoder over separable 'images': full pipeline returns high
+    top-1 and a populated result."""
+    def apply_fn(x):
+        return x.reshape(len(x), -1)
+
+    def mk(n, seed):
+        x, y = _blobs(n, d=12, classes=3, seed=seed)
+        def it():
+            for lo in range(0, n, 16):
+                xb = x[lo:lo + 16].reshape(-1, 2, 2, 3)
+                yield {"view1": xb, "view2": xb,
+                       "label": y[lo:lo + 16].astype(np.int32)}
+        return it()
+
+    res = linear_eval(apply_fn, mk(600, 0), mk(200, 1), num_classes=3,
+                      epochs=10, lr=0.5)
+    assert res.top1 > 90.0
+    assert res.num_train == 600 and res.num_test == 200
