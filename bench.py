@@ -30,8 +30,9 @@ forwards) = 8 encoder-forward-equivalents; head MLP/probe FLOPs are <1% of
 the RN50 trunk at 224px and are ignored.
 
 Usage:
-  python bench.py            # the two headline configs -> one JSON line
-  python bench.py --sweep    # batch x remat x fuse grid -> bench_sweep.json
+  python bench.py                  # the two headline configs -> one JSON line
+  python bench.py --sweep          # batch x remat x fuse grid -> bench_sweep.json
+  python bench.py --profile DIR    # jax.profiler trace of the headline config
 """
 from __future__ import annotations
 
@@ -158,7 +159,7 @@ def main():
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
         arch, image_size = "resnet50", 224
-        candidates = [512, 256, 128, 64, 32]
+        candidates = [1024, 512, 256, 128, 64, 32]
     else:  # CPU fallback so the bench never hard-fails off-hardware
         arch, image_size = "resnet18", 32
         candidates = [64, 32]
@@ -197,6 +198,12 @@ def main():
     if "--sweep" in sys.argv[1:]:
         _sweep(arch, image_size, candidates, mfu_of)
         return
+    if "--profile" in sys.argv[1:]:
+        i = sys.argv.index("--profile") + 1
+        if i >= len(sys.argv):
+            raise SystemExit("usage: bench.py --profile <logdir>")
+        _profile(arch, image_size, candidates, sys.argv[i])
+        return
 
     value = best_throughput("tpu_first", half=True, fuse_views=True,
                             ema_update_mode="post")
@@ -217,6 +224,37 @@ def main():
                         if baseline is not None else None),
         "mfu": round(mfu, 4) if mfu is not None else None,
     }))
+
+
+def _profile(arch, image_size, candidates, logdir):
+    """Capture a jax.profiler trace of a few steady-state headline-config
+    steps (TensorBoard profile plugin / Perfetto readable) — the tuning
+    input for the MFU push (RESULTS.md §1)."""
+    for bs in candidates:
+        try:
+            state, train_step, batch = _build(
+                bs, image_size, arch, half=True, fuse_views=True,
+                ema_update_mode="post")
+            # the jit compiles lazily at the first call — it must sit inside
+            # the ladder's try (compile-time OOM = did-not-fit, module doc)
+            for _ in range(3):                  # compile + warm
+                state, metrics = train_step(state, batch)
+            float(metrics["loss_mean"])
+        except Exception:
+            print(f"bench: profile bs={bs} failed (treating as "
+                  f"did-not-fit):", file=sys.stderr)
+            traceback.print_exc()
+            continue
+        jax.profiler.start_trace(logdir)
+        for _ in range(5):
+            state, metrics = train_step(state, batch)
+        float(metrics["loss_mean"])             # readback inside the trace
+        jax.profiler.stop_trace()
+        print(json.dumps({"metric": "profile", "value": bs,
+                          "unit": "batch/chip", "vs_baseline": None,
+                          "logdir": logdir}))
+        return
+    raise RuntimeError("no batch size fit for profiling")
 
 
 def _sweep(arch, image_size, candidates, mfu_of):

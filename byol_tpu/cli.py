@@ -41,6 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--image-size-override", type=int, default=224)
     t.add_argument("--data-dir", type=str, default="./data")
     t.add_argument("--log-dir", type=str, default="./runs")
+    t.add_argument("--grapher", type=str, default="both",
+                   choices=("tensorboard", "jsonl", "both", "null"),
+                   help="metric writer(s); the reference's visdom|TB switch "
+                        "analog (visdom dropped, jsonl added)")
     t.add_argument("--uid", type=str, default="")
     # Model (main.py:56-70)
     m = p.add_argument_group("model")
@@ -104,6 +108,16 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--half", action="store_true", default=True,
                    help="bf16 compute policy (apex O2 analog)")
     d.add_argument("--no-half", dest="half", action="store_false")
+    d.add_argument("--no-cuda", action="store_true",
+                   help="force the CPU backend (reference main.py:113; here "
+                        "it means 'no accelerator': jax_platforms=cpu)")
+    # Reference visdom flags (main.py:94-97) accepted for drop-in
+    # compatibility; the backend itself is dropped (SURVEY §5.5) — setting
+    # them warns and falls back to --grapher.
+    d.add_argument("--visdom-url", type=str, default=None,
+                   help=argparse.SUPPRESS)
+    d.add_argument("--visdom-port", type=int, default=None,
+                   help=argparse.SUPPRESS)
     # TPU-native extensions
     x = p.add_argument_group("tpu")
     x.add_argument("--model-parallel", type=int, default=1,
@@ -156,6 +170,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             download=bool(args.download),
             image_size_override=args.image_size_override,
             log_dir=args.log_dir, uid=args.uid,
+            grapher=args.grapher,
             data_backend=args.data_backend),
         model=ModelConfig(
             arch=args.arch,
@@ -202,6 +217,14 @@ def config_from_args(args: argparse.Namespace) -> Config:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.no_cuda:
+        # must precede any backend initialization; the config API overrides
+        # even platform plugins forced by sitecustomize-style preloads
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if args.visdom_url or args.visdom_port:
+        print("byol_tpu: visdom backend is not supported (SURVEY §5.5); "
+              f"metrics go to --grapher={args.grapher} under --log-dir")
     # Multi-host rendezvous MUST happen before anything initializes the local
     # XLA backend (config_from_args queries jax.device_count()).  The
     # reference had the same ordering constraint around init_process_group

@@ -32,6 +32,10 @@ class TaskConfig:
     image_size_override: Optional[int] = 224  # ref main.py:46-47
     log_dir: str = "./runs"
     uid: str = ""                       # run identity (ref main.py:52-53)
+    # Metric writer: 'tensorboard' | 'jsonl' | 'both' | 'null' — the
+    # reference's visdom|tensorboard switch analog (main.py:452-460; visdom
+    # dropped, jsonl added so committed evidence is machine-readable).
+    grapher: str = "both"
     # Augmentation backend for array datasets: 'tf' (tf.data host), 'native'
     # (multithreaded C++ host kernel, data/native/), or 'device' (on-chip
     # jitted two-view augmentation, data/device_augment.py).  The latter two

@@ -15,8 +15,9 @@ scalars, only ``*_img``/``*_imgs`` as images (first <=64 samples, downscaled
 to <=64 px), and only process 0 writes (rank-0 discipline, main.py:452).
 
 Backends: ``tensorboard`` (torch SummaryWriter), ``jsonl`` (newline-JSON for
-machines), ``null``.  All writes are host-side and O(scalar count) — nothing
-here touches device buffers except the explicit image grids.
+machines), ``both`` (TB + jsonl — the default: committed evidence stays
+greppable), ``null``.  All writes are host-side and O(scalar count) —
+nothing here touches device buffers except the explicit image grids.
 """
 from __future__ import annotations
 
@@ -53,22 +54,22 @@ class Grapher:
         self.logdir = os.path.join(logdir, run_name)
         self._tb = None
         self._jsonl = None
-        if self.backend == "tensorboard":
+        if self.backend in ("tensorboard", "both"):
             from torch.utils.tensorboard import SummaryWriter
             os.makedirs(self.logdir, exist_ok=True)
             self._tb = SummaryWriter(log_dir=self.logdir)
-        elif self.backend == "jsonl":
+        if self.backend in ("jsonl", "both"):
             os.makedirs(self.logdir, exist_ok=True)
             self._jsonl = open(os.path.join(self.logdir, "metrics.jsonl"),
                                "a", buffering=1)
-        elif self.backend != "null":
+        if self.backend not in ("tensorboard", "jsonl", "both", "null"):
             raise ValueError(f"unknown grapher backend {self.backend!r}")
 
     # -- primitive writes --------------------------------------------------
     def add_scalar(self, key: str, value: float, step: int) -> None:
         if self._tb is not None:
             self._tb.add_scalar(key, float(value), step)
-        elif self._jsonl is not None:
+        if self._jsonl is not None:
             self._jsonl.write(json.dumps(
                 {"t": time.time(), "step": step, key: float(value)}) + "\n")
 
@@ -81,7 +82,7 @@ class Grapher:
     def add_text(self, key: str, text: str, step: int = 0) -> None:
         if self._tb is not None:
             self._tb.add_text(key, text, step)
-        elif self._jsonl is not None:
+        if self._jsonl is not None:
             self._jsonl.write(json.dumps(
                 {"t": time.time(), "step": step, key: text}) + "\n")
 

@@ -128,7 +128,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
 
     name = run_name(cfg)
     if grapher is None:
-        grapher = Grapher("tensorboard", logdir=cfg.task.log_dir,
+        grapher = Grapher(cfg.task.grapher, logdir=cfg.task.log_dir,
                           run_name=name)
     saver = ModelSaver(
         os.path.join(cfg.model.model_dir, name),

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# One-command TPU evidence capture for RESULTS.md — run when a real chip is
+# attached (jax.devices() shows TPU).  Produces, in order:
+#   1. the headline benchmark artifact     -> bench_partial.json + stdout line
+#   2. the batch x remat x fuse sweep      -> bench_sweep.json
+#   3. a profiler trace of the best config -> /tmp/byol_profile
+#   4. a learnable-dataset training run with decreasing BYOL loss and an
+#      offline linear probe                -> runs/<uid>/metrics.jsonl
+# Each stage is independent; a failure in one does not block the next.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== 1/4 headline bench =="
+python bench.py || echo "bench failed (see stderr)"
+
+echo "== 2/4 sweep =="
+python bench.py --sweep || echo "sweep failed"
+
+echo "== 3/4 profile =="
+python bench.py --profile /tmp/byol_profile || echo "profile failed"
+
+echo "== 4/4 synth learning evidence =="
+python train.py --task synth --batch-size 512 --epochs 12 \
+    --arch resnet18 --image-size-override 32 --head-latent-size 512 \
+    --projection-size 128 --lr 0.8 --warmup 2 --fuse-views \
+    --linear-eval --uid synth_evidence \
+    --log-dir runs --model-dir /tmp/synth_models || echo "evidence run failed"
+echo "metrics at runs/<run-name>/ (tfevents); commit them with RESULTS.md"

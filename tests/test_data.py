@@ -100,6 +100,10 @@ class TestImageFolder:
         test_batch = next(bundle.test_loader)
         np.testing.assert_array_equal(test_batch["view1"],
                                       test_batch["view2"])
+        # offline linear-eval input: TRAIN split under the EVAL transform
+        te_batch = next(bundle.train_eval_loader)
+        np.testing.assert_array_equal(te_batch["view1"], te_batch["view2"])
+        assert te_batch["view1"].shape == (4, 32, 32, 3)
 
     def test_missing_root_raises(self, tmp_path):
         cfg = Config(task=TaskConfig(task="image_folder",

@@ -39,6 +39,16 @@ def test_tensorboard_backend_writes(tmp_path):
     assert any("tfevents" in f for f in files)
 
 
+def test_both_backend_writes_tb_and_jsonl(tmp_path):
+    g = Grapher("both", logdir=str(tmp_path), run_name="b", enabled=True)
+    g.register_plots({"loss_mean": 2.5}, step=1, prefix="train")
+    g.close()
+    files = os.listdir(tmp_path / "b")
+    assert any("tfevents" in f for f in files)
+    lines = [json.loads(l) for l in open(tmp_path / "b" / "metrics.jsonl")]
+    assert any(l.get("train_loss_mean") == 2.5 for l in lines)
+
+
 def test_disabled_grapher_is_noop(tmp_path):
     g = Grapher("tensorboard", logdir=str(tmp_path), run_name="off",
                 enabled=False)

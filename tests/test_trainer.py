@@ -209,6 +209,10 @@ def test_cli_parser_reference_surface(tmp_path):
     # (device-axis size): hosts driving several chips have different values.
     args = build_parser().parse_args([])
     assert args.num_processes == 0   # auto-detect from pod metadata
+    # full reference device/visdom surface parses (visdom warns at runtime)
+    args = build_parser().parse_args(
+        ["--no-cuda", "--visdom-url", "http://x", "--visdom-port", "8097"])
+    assert args.no_cuda and args.visdom_url == "http://x"
 
     args = build_parser().parse_args([
         "--task", "fake", "--batch-size", "16", "--epochs", "1",
