@@ -9,9 +9,11 @@ documented inline.
 Semantics preserved: --batch-size is GLOBAL (split across the data axis, the
 main.py:725 analog); --lr is linearly scaled by global_batch/256 for
 sgd/momentum inside the optimizer factory (main.py:333-334); 'lars_' prefix
-composes (main.py:323).  Deltas: --no-cuda/--half become the bf16 policy
-switch; --visdom-url is dropped (tensorboard only, documented in SURVEY.md
-§5.5); --num-replicas defaults to the detected device count.
+composes (main.py:323).  Deltas: --half selects the bf16 policy and
+--no-cuda forces the CPU backend; the visdom BACKEND is dropped (SURVEY.md
+§5.5) but --visdom-url/--visdom-port still parse (warn + fall back to
+--grapher, which offers tensorboard | jsonl | both | null);
+--num-replicas defaults to the detected device count.
 """
 from __future__ import annotations
 
