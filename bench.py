@@ -243,37 +243,31 @@ def _profile(arch, image_size, candidates, logdir):
 
     Like ``best_throughput``, the FASTEST of the top two fitting rungs is
     the one traced — the largest fitting batch can be the slower, spilling
-    one, and a trace of the degraded config would misdirect the tuning."""
-    chosen = None                        # (rate, bs, state, step, batch)
-    fitted = 0
+    one, and a trace of the degraded config would misdirect the tuning.
+    Rungs are measured one at a time with nothing retained (holding rung
+    A's buffers while building rung B would change B's memory picture);
+    the winner is rebuilt for the trace (compile is cached)."""
+    rates = []                                  # (rate, bs)
     for bs in candidates:
         try:
-            state, train_step, batch = _build(
-                bs, image_size, arch, half=True, fuse_views=True,
-                ema_update_mode="post")
-            # the jit compiles lazily at the first call — it must sit inside
-            # the ladder's try (compile-time OOM = did-not-fit, module doc)
-            for _ in range(3):                  # compile + warm
-                state, metrics = train_step(state, batch)
-            float(metrics["loss_mean"])
-            t0 = time.perf_counter()
-            for _ in range(5):
-                state, metrics = train_step(state, batch)
-            float(metrics["loss_mean"])
-            rate = 5 * batch["label"].shape[0] / (time.perf_counter() - t0)
+            rates.append((_throughput(bs, image_size, arch, half=True,
+                                      fuse_views=True,
+                                      ema_update_mode="post", steps=5), bs))
         except Exception:
             print(f"bench: profile bs={bs} failed (treating as "
                   f"did-not-fit):", file=sys.stderr)
             traceback.print_exc()
             continue
-        if chosen is None or rate > chosen[0]:
-            chosen = (rate, bs, state, train_step, batch)
-        fitted += 1
-        if fitted >= 2:
+        if len(rates) >= 2:
             break
-    if chosen is None:
+    if not rates:
         raise RuntimeError("no batch size fit for profiling")
-    _, bs, state, train_step, batch = chosen
+    bs = max(rates)[1]
+    state, train_step, batch = _build(bs, image_size, arch, half=True,
+                                      fuse_views=True, ema_update_mode="post")
+    for _ in range(3):                          # compile (cached) + warm
+        state, metrics = train_step(state, batch)
+    float(metrics["loss_mean"])
     jax.profiler.start_trace(logdir)
     for _ in range(5):
         state, metrics = train_step(state, batch)

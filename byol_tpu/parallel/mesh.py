@@ -98,10 +98,40 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch_to_mesh(batch, mesh: Mesh):
-    """Place a host-global numpy batch onto the mesh, batch dim over 'data'."""
+    """Place a host batch onto the mesh, batch dim over 'data'.
+
+    Single process: a plain sharded device_put.  Multi-host: each process
+    holds only ITS slice of the global batch (the loader's per-host shard,
+    loader.py), so the global array is assembled with
+    ``jax.make_array_from_process_local_data`` — device_put would demand
+    the full global array on every host.  Works because both the loader's
+    host sharding and the mesh's data axis order hosts by process index
+    (contiguous rows ↔ contiguous devices)."""
     sh = data_sharding(mesh)
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, sh), batch)
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), batch)
+
+    # Global rows = local rows x (processes spanned by the DATA axis), NOT
+    # x process_count: with e.g. multi-host TP (data=1, model=N) the batch
+    # is replicated over hosts and the local array IS the global one.
+    pid = jax.process_index()
+    data_size = mesh.shape[DATA_AXIS]
+    own = {i for i in range(data_size)
+           if any(d.process_index == pid
+                  for d in mesh.devices[i].flat)}
+    if data_size % len(own) != 0:
+        raise ValueError(
+            f"data axis ({data_size}) unevenly split across processes: "
+            f"this host owns indices {sorted(own)}")
+    multiplier = data_size // len(own)
+
+    def put(x):
+        x = np.asarray(x)
+        global_shape = (x.shape[0] * multiplier,) + x.shape[1:]
+        return jax.make_array_from_process_local_data(sh, x, global_shape)
+
+    return jax.tree_util.tree_map(put, batch)
 
 
 def local_device_count(mesh: Mesh) -> int:
