@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--projection-size", type=int, default=256)
     m.add_argument("--head-latent-size", type=int, default=4096)
     m.add_argument("--base-decay", type=float, default=0.996)
+    m.add_argument("--ema-scaling-reference-batch", type=int, default=0,
+                   help="scale tau as tau^(batch/this) so target-EMA "
+                        "dynamics stay batch-size invariant (the EMA "
+                        "scaling rule, arXiv 2307.13813); 0 = off")
     m.add_argument("--weight-initialization", type=str, default=None)
     m.add_argument("--model-dir", type=str, default=".models")
     # Regularizer (main.py:72-78)
@@ -181,6 +185,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             projection_size=args.projection_size,
             head_latent_size=args.head_latent_size,
             base_decay=args.base_decay,
+            ema_scaling_reference_batch=args.ema_scaling_reference_batch,
             weight_initialization=args.weight_initialization,
             model_dir=args.model_dir,
             fuse_views=args.fuse_views, remat=args.remat,

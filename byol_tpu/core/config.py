@@ -54,6 +54,11 @@ class ModelConfig:
     projection_size: int = 256          # ref main.py:61-62
     head_latent_size: int = 4096        # ref main.py:63-64 (projector hidden)
     base_decay: float = 0.996           # EMA tau_0 (ref main.py:65-66)
+    # EMA scaling rule ("How to Scale Your EMA", arXiv 2307.13813): when
+    # training at a different global batch than the recipe was tuned for,
+    # tau must scale as tau^kappa (kappa = batch/reference_batch) to keep
+    # the target-network dynamics batch-size invariant.  0 disables.
+    ema_scaling_reference_batch: int = 0
     weight_initialization: Optional[str] = None  # ref main.py:67-68
     model_dir: str = ".models"
     # TPU-native additions (no reference analog):

@@ -83,12 +83,24 @@ def build_tx(rcfg: ResolvedConfig):
 
 def step_config(rcfg: ResolvedConfig) -> StepConfig:
     cfg = rcfg.cfg
+    base_decay = cfg.model.base_decay
+    polyak = cfg.regularizer.polyak_ema
+    ref_b = cfg.model.ema_scaling_reference_batch
+    if ref_b > 0:
+        # EMA scaling rule (arXiv 2307.13813): tau -> tau^kappa keeps an
+        # EMA's time constant (in SAMPLES, not steps) invariant when the
+        # global batch deviates from the recipe's reference batch.  The
+        # rule covers every model EMA — target decay AND Polyak averaging.
+        kappa = rcfg.global_batch_size / ref_b
+        base_decay = float(base_decay ** kappa)
+        if polyak > 0.0:
+            polyak = float(polyak ** kappa)
     return StepConfig(
         total_train_steps=rcfg.total_train_steps,
-        base_decay=cfg.model.base_decay,
+        base_decay=base_decay,
         norm_mode=cfg.parity.loss_norm_mode,
         fuse_views=cfg.model.fuse_views,
-        polyak_ema=cfg.regularizer.polyak_ema,
+        polyak_ema=polyak,
         ema_update_mode=cfg.parity.ema_update_mode)
 
 

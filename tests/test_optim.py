@@ -138,6 +138,35 @@ class TestFactory:
         np.testing.assert_allclose(np.asarray(params["w"]),
                                    np.asarray(target), atol=1e-2)
 
+    def test_ema_scaling_rule(self):
+        """tau^kappa batch-size scaling (arXiv 2307.13813): halving the
+        batch relative to the reference square-roots the decay... inverse:
+        kappa = batch/ref, tau_eff = tau^kappa."""
+        from byol_tpu.core.config import Config, DeviceConfig, ModelConfig, \
+            RegularizerConfig, TaskConfig, resolve
+        from byol_tpu.training.build import step_config
+
+        def scfg_for(batch, ref):
+            cfg = Config(
+                task=TaskConfig(task="fake", batch_size=batch, epochs=1,
+                                image_size_override=16),
+                model=ModelConfig(arch="resnet18", base_decay=0.996,
+                                  ema_scaling_reference_batch=ref),
+                regularizer=RegularizerConfig(polyak_ema=0.999),
+                device=DeviceConfig(num_replicas=1))
+            rcfg = resolve(cfg, num_train_samples=4 * batch,
+                           num_test_samples=batch, output_size=10,
+                           input_shape=(16, 16, 3))
+            return step_config(rcfg)
+
+        assert scfg_for(512, 0).base_decay == 0.996          # rule off
+        assert scfg_for(512, 512).base_decay == pytest.approx(0.996)
+        assert scfg_for(1024, 512).base_decay == pytest.approx(0.996 ** 2)
+        assert scfg_for(256, 512).base_decay == pytest.approx(0.996 ** 0.5)
+        # the rule covers EVERY model EMA: Polyak averaging scales too
+        assert scfg_for(512, 0).polyak_ema == 0.999
+        assert scfg_for(1024, 512).polyak_ema == pytest.approx(0.999 ** 2)
+
     def test_unknown_raises(self):
         with pytest.raises(ValueError, match="unknown optimizer"):
             build_optimizer("frobnicate", base_lr=0.1, global_batch_size=256,
