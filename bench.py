@@ -155,7 +155,35 @@ def _record(name: str, **fields):
     _flush_partial()
 
 
+def _preflight_backend(timeout_s: float = 180.0) -> None:
+    """Probe backend initialization in a KILLABLE subprocess first.
+
+    A SIGTERM-killed TPU run can wedge the axon tunnel for hours, after
+    which backend init blocks forever inside C — un-interruptible from this
+    process.  Probing in a subprocess turns an unattended infinite hang
+    into a fast, explained failure."""
+    if jax.config.jax_platforms == "cpu":
+        return   # explicitly pinned to CPU (tests/smokes): nothing to probe
+    import subprocess
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "print(float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()))"],
+            timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        raise SystemExit(
+            f"bench: backend failed to initialize within {timeout_s:.0f}s — "
+            "the TPU tunnel is likely wedged (a previously killed TPU "
+            "process leaves it hung for hours). No measurement possible; "
+            "rerun when a probe matmul succeeds.")
+    if probe.returncode != 0:
+        raise SystemExit(
+            "bench: backend probe failed:\n" + probe.stderr[-2000:])
+
+
 def main():
+    _preflight_backend()
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
         arch, image_size = "resnet50", 224
