@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
     # Regularizer (main.py:72-78)
     r = p.add_argument_group("regularizer")
     r.add_argument("--color-jitter-strength", type=float, default=1.0)
+    r.add_argument("--aug-spec", type=str, default="reference",
+                   choices=("reference", "paper"),
+                   help="'reference' = the symmetric reference stack; "
+                        "'paper' = BYOL's asymmetric recipe (solarize + "
+                        "asymmetric blur, arXiv 2006.07733 App B)")
     r.add_argument("--weight-decay", type=float, default=1e-6)
     r.add_argument("--polyak-ema", type=float, default=0.0)
     r.add_argument("--convert-to-sync-bn",
@@ -192,6 +197,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             attn_impl=args.attn_impl, pooling=args.pooling),
         regularizer=RegularizerConfig(
             color_jitter_strength=args.color_jitter_strength,
+            aug_spec=args.aug_spec,
             weight_decay=args.weight_decay,
             polyak_ema=args.polyak_ema,
             convert_to_sync_bn=args.convert_to_sync_bn),

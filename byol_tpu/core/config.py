@@ -80,6 +80,11 @@ class RegularizerConfig:
     """Regularizer group (reference main.py:72-78)."""
 
     color_jitter_strength: float = 1.0
+    # 'reference': the symmetric torchvision stack (main.py:386-397).
+    # 'paper': BYOL's asymmetric recipe (arXiv 2006.07733 App B — solarize +
+    # asymmetric blur; the spec behind 74.3% that the reference never had).
+    # tf data backend only.
+    aug_spec: str = "reference"
     weight_decay: float = 1e-6
     polyak_ema: float = 0.0
     convert_to_sync_bn: bool = True     # under GSPMD jit, BN is cross-replica

@@ -19,6 +19,13 @@ random order; here the order is fixed brightness→contrast→saturation→hue.
 All randomness is stateless (seeded per-sample from (seed, epoch, index)) so
 epoch reshuffling is deterministic — the ``set_all_epochs`` analog
 (main.py:760) is just a different fold-in.
+
+``aug_spec="paper"`` selects the BYOL paper's ASYMMETRIC recipe instead
+(arXiv 2006.07733 App. B — the spec behind the 74.3% headline, which the
+reference never implemented): jitter strengths (.4s, .4s, .2s, .1s); view 1
+blurs with p=1.0 and never solarizes; view 2 blurs with p=0.1 and solarizes
+(threshold 0.5) with p=0.2.  ``"reference"`` (default) keeps the symmetric
+reference stack above.
 """
 from __future__ import annotations
 
@@ -57,14 +64,15 @@ def _blend(a: tf.Tensor, b: tf.Tensor, factor: tf.Tensor) -> tf.Tensor:
     return tf.clip_by_value(factor * a + (1.0 - factor) * b, 0.0, 1.0)
 
 
-def color_jitter(image: tf.Tensor, strength: float, seed) -> tf.Tensor:
-    """torchvision ColorJitter(brightness=.8s, contrast=.8s, saturation=.8s,
-    hue=.2s) with multiplicative brightness (torch semantics, not tf's
-    additive one)."""
-    b = 0.8 * strength
-    c = 0.8 * strength
-    s = 0.8 * strength
-    h = 0.2 * strength
+def color_jitter(image: tf.Tensor, strength: float, seed,
+                 factors=(0.8, 0.8, 0.8, 0.2)) -> tf.Tensor:
+    """torchvision ColorJitter(brightness, contrast, saturation, hue) =
+    ``factors`` x ``strength``, with multiplicative brightness (torch
+    semantics, not tf's additive one)."""
+    b = factors[0] * strength
+    c = factors[1] * strength
+    s = factors[2] * strength
+    h = factors[3] * strength
     seeds = _split(seed, 4)
     # brightness: img * U(max(0, 1-b), 1+b)
     image = tf.clip_by_value(
@@ -88,6 +96,33 @@ def random_grayscale(image: tf.Tensor, seed, p: float = 0.2) -> tf.Tensor:
     return tf.where(_uniform(seed) < p, gray, image)
 
 
+def solarize(image: tf.Tensor, threshold: float = 0.5) -> tf.Tensor:
+    """Invert pixels above ``threshold`` (paper spec, view 2 only)."""
+    return tf.where(image < threshold, image, 1.0 - image)
+
+
+# Per-(spec, view) parameters.  The reference spec is symmetric
+# (main.py:386-397); the paper spec is asymmetric (arXiv 2006.07733 App B).
+_VIEW_PARAMS = {
+    ("reference", 0): dict(jitter=(0.8, 0.8, 0.8, 0.2), blur_p=0.5,
+                           solarize_p=0.0),
+    ("reference", 1): dict(jitter=(0.8, 0.8, 0.8, 0.2), blur_p=0.5,
+                           solarize_p=0.0),
+    ("paper", 0): dict(jitter=(0.4, 0.4, 0.2, 0.1), blur_p=1.0,
+                       solarize_p=0.0),
+    ("paper", 1): dict(jitter=(0.4, 0.4, 0.2, 0.1), blur_p=0.1,
+                       solarize_p=0.2),
+}
+
+
+def view_params(spec: str, view: int) -> dict:
+    try:
+        return _VIEW_PARAMS[(spec, view)]
+    except KeyError:
+        raise ValueError(f"unknown aug spec/view {(spec, view)!r}; specs: "
+                         f"'reference' | 'paper', views: 0 | 1") from None
+
+
 def gaussian_blur(image: tf.Tensor, kernel_size: int, seed,
                   sigma_range=(0.1, 2.0)) -> tf.Tensor:
     """Depthwise separable gaussian blur; kernel_size = int(.1 * image_size)
@@ -107,31 +142,40 @@ def gaussian_blur(image: tf.Tensor, kernel_size: int, seed,
 
 
 def post_crop_augment(image: tf.Tensor, size: int, seed,
-                      color_jitter_strength: float = 1.0) -> tf.Tensor:
+                      color_jitter_strength: float = 1.0, *,
+                      jitter=(0.8, 0.8, 0.8, 0.2), blur_p: float = 0.5,
+                      solarize_p: float = 0.0) -> tf.Tensor:
     """Everything after the crop: flip, jitter(p=.8), grayscale(p=.2),
-    blur(p=.5), [0,1] clip.  Single source of truth shared by the host-array
-    pipeline and the ImageFolder pipeline (whose crop is fused with JPEG
-    decode).  The blur gate and blur sigma get INDEPENDENT seeds — reusing
-    one seed would make sigma a deterministic function of the gate draw."""
-    seeds = _split(seed, 6)
+    blur(p=blur_p), solarize(p=solarize_p), [0,1] clip.  Single source of
+    truth shared by the host-array pipeline and the ImageFolder pipeline
+    (whose crop is fused with JPEG decode).  The blur gate and blur sigma
+    get INDEPENDENT seeds — reusing one seed would make sigma a
+    deterministic function of the gate draw."""
+    seeds = _split(seed, 7)
     image = tf.image.stateless_random_flip_left_right(image, seeds[0])
     image = tf.where(_uniform(seeds[1]) < 0.8,
-                     color_jitter(image, color_jitter_strength, seeds[2]),
+                     color_jitter(image, color_jitter_strength, seeds[2],
+                                  factors=jitter),
                      image)
     image = random_grayscale(image, seeds[3], p=0.2)
-    image = tf.where(_uniform(seeds[4]) < 0.5,
+    image = tf.where(_uniform(seeds[4]) < blur_p,
                      gaussian_blur(image, int(0.1 * size), seeds[5]),
                      image)
+    if solarize_p > 0.0:
+        image = tf.where(_uniform(seeds[6]) < solarize_p,
+                         solarize(image), image)
     image = tf.reshape(image, (size, size, 3))
     return tf.clip_by_value(image, 0.0, 1.0)
 
 
 def train_augment(image: tf.Tensor, size: int, seed,
-                  color_jitter_strength: float = 1.0) -> tf.Tensor:
+                  color_jitter_strength: float = 1.0, *,
+                  spec: str = "reference", view: int = 0) -> tf.Tensor:
     """One augmented view: image float32 [0,1] HWC -> (size, size, 3)."""
     s_crop, s_rest = _split(seed, 2)
     image = random_resized_crop(image, size, s_crop)
-    return post_crop_augment(image, size, s_rest, color_jitter_strength)
+    return post_crop_augment(image, size, s_rest, color_jitter_strength,
+                             **view_params(spec, view))
 
 
 def test_resize(image: tf.Tensor, size: int) -> tf.Tensor:
@@ -141,10 +185,13 @@ def test_resize(image: tf.Tensor, size: int) -> tf.Tensor:
 
 
 def two_views(image: tf.Tensor, size: int, seed,
-              color_jitter_strength: float = 1.0
-              ) -> Tuple[tf.Tensor, tf.Tensor]:
+              color_jitter_strength: float = 1.0,
+              spec: str = "reference") -> Tuple[tf.Tensor, tf.Tensor]:
     """Two independently-augmented views of one image — the
-    ``multi_augment_image_folder`` contract (main.py:475,579)."""
+    ``multi_augment_image_folder`` contract (main.py:475,579).  Views are
+    asymmetric under ``spec='paper'`` (module docstring)."""
     s1, s2 = _split(seed, 2)
-    return (train_augment(image, size, s1, color_jitter_strength),
-            train_augment(image, size, s2, color_jitter_strength))
+    return (train_augment(image, size, s1, color_jitter_strength,
+                          spec=spec, view=0),
+            train_augment(image, size, s2, color_jitter_strength,
+                          spec=spec, view=1))

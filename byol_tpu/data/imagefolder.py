@@ -131,7 +131,7 @@ def image_folder_loader(cfg: Config, *, host_batch: int,
                     # collide across samples: i's view2 == (i+k)'s view1).
                     view_seeds = augment._split(s0, 2)
                     views = []
-                    for sv in view_seeds:
+                    for vi, sv in enumerate(view_seeds):
                         s_crop, s_rest = augment._split(sv, 2)
                         crop = tf.cond(
                             _is_jpeg(ex["path"]),
@@ -140,7 +140,9 @@ def image_folder_loader(cfg: Config, *, host_batch: int,
                             lambda s=s_crop: augment.random_resized_crop(
                                 _decode_full(data), size, s))
                         views.append(augment.post_crop_augment(
-                            crop, size, s_rest, cj))
+                            crop, size, s_rest, cj,
+                            **augment.view_params(
+                                cfg.regularizer.aug_spec, vi)))
                     return {"view1": views[0], "view2": views[1],
                             "label": ex["label"]}
                 img = augment.test_resize(_decode_full(data), size)

@@ -89,7 +89,8 @@ def _shard_arrays(x: np.ndarray, y: np.ndarray, index: int, count: int):
 def _array_pipeline(images: np.ndarray, labels: np.ndarray, *,
                     batch_size: int, image_size: int, train: bool,
                     color_jitter_strength: float, seed: int,
-                    shuffle: bool) -> Callable[[int], Iterator[Batch]]:
+                    shuffle: bool, aug_spec: str = "reference"
+                    ) -> Callable[[int], Iterator[Batch]]:
     """tf.data pipeline over in-memory arrays -> numpy batch iterator.
 
     Train: two independently-augmented views; test: one resize applied to
@@ -113,7 +114,8 @@ def _array_pipeline(images: np.ndarray, labels: np.ndarray, *,
                 s = tf.stack([tf.cast(ex["index"], tf.int32),
                               tf.constant(seed, tf.int32) + epoch])
                 v1, v2 = augment.two_views(
-                    img, image_size, s, color_jitter_strength)
+                    img, image_size, s, color_jitter_strength,
+                    spec=aug_spec)
             else:
                 v1 = augment.test_resize(img, image_size)
                 v2 = v1
@@ -224,9 +226,28 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
     host_batch = cfg.task.batch_size // count
 
     if task == "image_folder":
+        # tf.data fused-decode path; supports every aug_spec
         from byol_tpu.data.imagefolder import image_folder_loader
         return image_folder_loader(cfg, host_batch=host_batch,
                                    shard_eval=shard_eval)
+
+    # Resolve the effective backend and validate the aug spec BEFORE any
+    # dataset download/load, so a bad combination fails fast.
+    backend = cfg.task.data_backend
+    if backend not in ("tf", "native", "device"):
+        raise ValueError(f"unknown data_backend {backend!r} "
+                         f"('tf'|'native'|'device')")
+    if backend == "native":
+        from byol_tpu.data import native_aug
+        if not native_aug.available():
+            # documented graceful degradation: no toolchain/binary -> tf.data
+            print("byol_tpu: native data backend unavailable "
+                  "(no g++/.so); falling back to tf.data")
+            backend = "tf"
+    if cfg.regularizer.aug_spec != "reference" and backend != "tf":
+        raise ValueError(
+            f"aug_spec={cfg.regularizer.aug_spec!r} is implemented on the "
+            f"tf data backend only (got data_backend={backend!r})")
 
     if task == "fake":
         size = cfg.task.image_size_override or 32
@@ -261,30 +282,19 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
         x_te, y_te = _shard_arrays(x_te, y_te, index, count)
 
     cj = cfg.regularizer.color_jitter_strength
-    backend = cfg.task.data_backend
+    import functools
     if backend == "native":
-        from byol_tpu.data import native_aug
-        if not native_aug.available():
-            # documented graceful degradation: no toolchain/binary -> tf.data
-            print("byol_tpu: native data backend unavailable "
-                  "(no g++/.so); falling back to tf.data")
-            backend = "tf"
-    if backend == "native":
-        import functools
         pipeline = functools.partial(
             _native_pipeline,
             num_threads=max(cfg.device.workers_per_replica, 1))
         test_pipeline = pipeline
     elif backend == "tf":
-        pipeline = test_pipeline = _array_pipeline
-    elif backend == "device":
+        pipeline = test_pipeline = functools.partial(
+            _array_pipeline, aug_spec=cfg.regularizer.aug_spec)
+    else:  # device
         # on-chip train augmentation; eval resize stays on host (its
         # throughput never gates the MXU)
         pipeline, test_pipeline = _device_pipeline, _array_pipeline
-    else:
-        raise ValueError(
-            f"unknown data_backend {cfg.task.data_backend!r} "
-            f"('tf'|'native'|'device')")
     return LoaderBundle(
         make_train_iter=pipeline(
             x_trs, y_trs, batch_size=host_batch, image_size=size, train=True,

@@ -241,3 +241,67 @@ class TestReaders:
         assert x.shape == (32, 16, 16, 3) and x.dtype == np.uint8
         x2, _ = readers.load_fake(32, 16, seed=3)
         np.testing.assert_array_equal(x, x2)
+
+
+class TestPaperAugSpec:
+    def test_view_params_table(self):
+        from byol_tpu.data import augment
+        ref0 = augment.view_params("reference", 0)
+        assert ref0 == augment.view_params("reference", 1)   # symmetric
+        assert ref0["blur_p"] == 0.5 and ref0["solarize_p"] == 0.0
+        p0 = augment.view_params("paper", 0)
+        p1 = augment.view_params("paper", 1)
+        assert p0["blur_p"] == 1.0 and p0["solarize_p"] == 0.0
+        assert p1["blur_p"] == 0.1 and p1["solarize_p"] == 0.2
+        assert p0["jitter"] == (0.4, 0.4, 0.2, 0.1)
+        with pytest.raises(ValueError, match="unknown aug spec"):
+            augment.view_params("bogus", 0)
+
+    def test_solarize_op(self):
+        import tensorflow as tf
+        from byol_tpu.data.augment import solarize
+        x = tf.constant([[0.1, 0.4], [0.6, 0.9]])
+        out = solarize(x[..., None]).numpy()[..., 0]
+        np.testing.assert_allclose(out, [[0.1, 0.4], [0.4, 0.1]], atol=1e-6)
+
+    def test_paper_two_views_contract(self):
+        """Paper-spec views keep the [0,1]/shape contract and view 1 is
+        ALWAYS blurred (p=1.0): a high-frequency image must come out with
+        lower total variation in view 1 than the raw crop scale suggests."""
+        import tensorflow as tf
+        from byol_tpu.data import augment
+        rng = np.random.RandomState(0)
+        img = tf.constant(rng.rand(64, 64, 3).astype(np.float32))
+        v1, v2 = augment.two_views(img, 32, tf.constant([3, 7], tf.int32),
+                                   spec="paper")
+        for v in (v1, v2):
+            assert v.shape == (32, 32, 3)
+            assert float(tf.reduce_min(v)) >= 0.0
+            assert float(tf.reduce_max(v)) <= 1.0
+        # blur p=1.0 on view1: white-noise input loses high-freq energy
+        tv = lambda t: float(tf.reduce_mean(tf.abs(t[1:] - t[:-1])))
+        raw = augment.random_resized_crop(img, 32, tf.constant([9, 9]))
+        assert tv(v1) < tv(raw)
+
+    def test_loader_rejects_paper_spec_off_tf_backend(self):
+        from byol_tpu.core.config import RegularizerConfig
+        cfg = Config(task=TaskConfig(task="fake", batch_size=8,
+                                     image_size_override=16,
+                                     data_backend="device"),
+                     regularizer=RegularizerConfig(aug_spec="paper"),
+                     device=DeviceConfig(num_replicas=1, seed=0))
+        with pytest.raises(ValueError, match="tf data backend"):
+            get_loader(cfg, num_fake_samples=16)
+
+    def test_loader_paper_spec_end_to_end(self):
+        from byol_tpu.core.config import RegularizerConfig
+        cfg = Config(task=TaskConfig(task="fake", batch_size=8,
+                                     image_size_override=16),
+                     regularizer=RegularizerConfig(aug_spec="paper"),
+                     device=DeviceConfig(num_replicas=1, seed=0))
+        bundle = get_loader(cfg, num_fake_samples=16)
+        b = next(iter(bundle.train_loader))
+        v1 = np.asarray(b["view1"])
+        assert v1.shape == (8, 16, 16, 3)
+        assert v1.min() >= 0.0 and v1.max() <= 1.0
+        assert not np.allclose(v1, np.asarray(b["view2"]))
