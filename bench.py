@@ -140,6 +140,57 @@ def _throughput(batch_size: int, image_size: int, arch: str, *, half: bool,
 _PARTIAL_PATH = "bench_partial.json"
 _partial: dict = {"results": []}
 
+# Failure classification for the "any failure = didn't fit" contract: a DEAD
+# BACKEND is not a fit failure.  Mislabeling it poisons the ladder (every
+# later rung "fails to fit" too) and burns hours hanging per config — seen
+# live when the axon tunnel dropped mid-sweep and bs256 (which fits and
+# measures 776 img/s) was recorded fit=False after a 25-minute hang.
+_BACKEND_DEAD_MARKERS = ("UNAVAILABLE", "backend setup", "DEADLINE_EXCEEDED",
+                         "Socket closed", "failed to connect")
+
+
+class BackendDied(RuntimeError):
+    """The accelerator backend is gone; no further config can measure."""
+
+
+_backend_dead = False
+
+
+def _note_backend_dead(context: str) -> None:
+    global _backend_dead
+    _backend_dead = True
+    print(f"bench: backend became unavailable during {context}; "
+          "skipping all remaining configs (measured results preserved in "
+          f"{_PARTIAL_PATH})", file=sys.stderr)
+    _record("backend_died", context=context)
+
+
+def _reraise_if_backend_dead(exc: BaseException) -> None:
+    """Raise BackendDied iff ``exc`` looks backend-fatal AND a liveness probe
+    confirms it.  The markers are broad (UNAVAILABLE is gRPC's generic
+    transient status), so a probe matmul disambiguates: a recoverable
+    per-config failure that merely mentions those words keeps the ladder
+    stepping down instead of aborting the whole bench."""
+    msg = str(exc)
+    if not any(m in msg for m in _BACKEND_DEAD_MARKERS):
+        return
+    import subprocess
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "print(float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()))"],
+            timeout=60.0, capture_output=True, text=True)
+        if probe.returncode == 0:
+            return   # backend alive: the failure was config-local
+    except subprocess.TimeoutExpired:
+        pass
+    raise BackendDied(
+        "accelerator backend became unavailable mid-run (error matched "
+        "a backend-death marker and a 60s probe matmul failed); aborting "
+        "the remaining configs (already-measured results are preserved in "
+        f"{_PARTIAL_PATH})") from exc
+
 
 def _flush_partial():
     try:
@@ -183,6 +234,12 @@ def _preflight_backend(timeout_s: float = 180.0) -> None:
 
 
 def main():
+    # Persistent compile cache: every config's XLA compile costs minutes over
+    # the tunneled backend; caching makes sweep re-runs (and headline re-runs
+    # after a mid-sweep backend drop) nearly free to resume.
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _preflight_backend()
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
@@ -191,6 +248,9 @@ def main():
     else:  # CPU fallback so the bench never hard-fails off-hardware
         arch, image_size = "resnet18", 32
         candidates = [64, 32]
+        # CPU smokes must not clobber the committed TPU evidence artifact
+        global _PARTIAL_PATH
+        _PARTIAL_PATH = "bench_partial_cpu.json"
 
     flops_per_sample = _flops_per_sample(arch, image_size)
     peak = _chip_peak_tflops()
@@ -216,9 +276,17 @@ def main():
         measured = 0
         best = None
         for bs in candidates:
+            if _backend_dead:
+                break
             try:
                 val = _throughput(bs, image_size, arch, **kw)
-            except Exception:
+            except Exception as e:
+                try:
+                    _reraise_if_backend_dead(e)
+                except BackendDied:
+                    traceback.print_exc()
+                    _note_backend_dead(f"config={name} bs/chip={bs}")
+                    break
                 print(f"bench: config={name} bs/chip={bs} failed "
                       f"(treating as did-not-fit):", file=sys.stderr)
                 traceback.print_exc()
@@ -249,6 +317,11 @@ def main():
                                fuse_views=False,
                                ema_update_mode="reference_pre", steps=10)
     if value is None:
+        if _backend_dead:
+            raise RuntimeError(
+                "backend became unavailable before the primary config "
+                "measured any batch size — NOT a memory ceiling; re-run "
+                f"when the backend is back (partial log in {_PARTIAL_PATH})")
         raise RuntimeError(
             "no batch size fit in memory for the primary config; "
             f"per-candidate tracebacks above, partial log in {_PARTIAL_PATH}")
@@ -281,7 +354,8 @@ def _profile(arch, image_size, candidates, logdir):
             rates.append((_throughput(bs, image_size, arch, half=True,
                                       fuse_views=True,
                                       ema_update_mode="post", steps=5), bs))
-        except Exception:
+        except Exception as e:
+            _reraise_if_backend_dead(e)  # dead backend: nothing to trace
             print(f"bench: profile bs={bs} failed (treating as "
                   f"did-not-fit):", file=sys.stderr)
             traceback.print_exc()
@@ -313,12 +387,20 @@ def _sweep(arch, image_size, candidates, mfu_of):
     for remat in (False, True):
         for fuse in (True, False):
             for bs in candidates:
+                if _backend_dead:
+                    break
                 name = f"sweep_bs{bs}_remat{int(remat)}_fuse{int(fuse)}"
                 try:
                     val = _throughput(bs, image_size, arch, half=True,
                                       fuse_views=fuse, remat=remat,
                                       ema_update_mode="post", steps=10)
-                except Exception:
+                except Exception as e:
+                    try:
+                        _reraise_if_backend_dead(e)
+                    except BackendDied:
+                        traceback.print_exc()
+                        _note_backend_dead(name)
+                        break
                     print(f"bench: {name} failed:", file=sys.stderr)
                     traceback.print_exc()
                     _record(name, batch_per_chip=bs, fit=False)

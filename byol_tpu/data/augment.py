@@ -64,8 +64,13 @@ def _blend(a: tf.Tensor, b: tf.Tensor, factor: tf.Tensor) -> tf.Tensor:
     return tf.clip_by_value(factor * a + (1.0 - factor) * b, 0.0, 1.0)
 
 
+# torchvision ColorJitter(.8s,.8s,.8s,.2s) — the reference stack
+# (main.py:391); single source for every default below.
+REFERENCE_JITTER = (0.8, 0.8, 0.8, 0.2)
+
+
 def color_jitter(image: tf.Tensor, strength: float, seed,
-                 factors=(0.8, 0.8, 0.8, 0.2)) -> tf.Tensor:
+                 factors=REFERENCE_JITTER) -> tf.Tensor:
     """torchvision ColorJitter(brightness, contrast, saturation, hue) =
     ``factors`` x ``strength``, with multiplicative brightness (torch
     semantics, not tf's additive one)."""
@@ -104,9 +109,9 @@ def solarize(image: tf.Tensor, threshold: float = 0.5) -> tf.Tensor:
 # Per-(spec, view) parameters.  The reference spec is symmetric
 # (main.py:386-397); the paper spec is asymmetric (arXiv 2006.07733 App B).
 _VIEW_PARAMS = {
-    ("reference", 0): dict(jitter=(0.8, 0.8, 0.8, 0.2), blur_p=0.5,
+    ("reference", 0): dict(jitter=REFERENCE_JITTER, blur_p=0.5,
                            solarize_p=0.0),
-    ("reference", 1): dict(jitter=(0.8, 0.8, 0.8, 0.2), blur_p=0.5,
+    ("reference", 1): dict(jitter=REFERENCE_JITTER, blur_p=0.5,
                            solarize_p=0.0),
     ("paper", 0): dict(jitter=(0.4, 0.4, 0.2, 0.1), blur_p=1.0,
                        solarize_p=0.0),
@@ -143,7 +148,7 @@ def gaussian_blur(image: tf.Tensor, kernel_size: int, seed,
 
 def post_crop_augment(image: tf.Tensor, size: int, seed,
                       color_jitter_strength: float = 1.0, *,
-                      jitter=(0.8, 0.8, 0.8, 0.2), blur_p: float = 0.5,
+                      jitter=REFERENCE_JITTER, blur_p: float = 0.5,
                       solarize_p: float = 0.0) -> tf.Tensor:
     """Everything after the crop: flip, jitter(p=.8), grayscale(p=.2),
     blur(p=blur_p), solarize(p=solarize_p), [0,1] clip.  Single source of

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Round-3 TPU capture: headline bench, tuning sweep, profile trace, synth
+# learning run.  Differs from tpu_evidence.sh in that it preserves each
+# stage's bench_partial.json (every bench.py invocation rewrites that file)
+# and tees all stdout/stderr to /tmp logs for post-hoc analysis.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p /tmp/tpu_capture
+
+echo "== 1/4 headline bench =="
+python bench.py > /tmp/tpu_capture/headline_stdout.json 2> /tmp/tpu_capture/headline_stderr.log
+echo "rc=$?"
+cp -f bench_partial.json /tmp/tpu_capture/headline_partial.json 2>/dev/null
+
+echo "== 2/4 sweep =="
+python bench.py --sweep > /tmp/tpu_capture/sweep_stdout.json 2> /tmp/tpu_capture/sweep_stderr.log
+echo "rc=$?"
+cp -f bench_partial.json /tmp/tpu_capture/sweep_partial.json 2>/dev/null
+
+echo "== 3/4 profile =="
+python bench.py --profile /tmp/byol_profile > /tmp/tpu_capture/profile_stdout.json 2> /tmp/tpu_capture/profile_stderr.log
+echo "rc=$?"
+
+echo "== 4/4 synth learning evidence =="
+python train.py --task synth --batch-size 512 --epochs 12 \
+    --arch resnet18 --image-size-override 32 --head-latent-size 512 \
+    --projection-size 128 --lr 0.8 --warmup 2 --fuse-views \
+    --linear-eval --uid synth_evidence \
+    --log-dir runs --model-dir /tmp/synth_models \
+    > /tmp/tpu_capture/synth_stdout.log 2> /tmp/tpu_capture/synth_stderr.log
+echo "rc=$?"
+echo "== capture done =="
