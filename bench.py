@@ -81,7 +81,8 @@ def _flops_per_sample(arch: str, image_size: int) -> float | None:
 
 
 def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
-           fuse_views: bool, ema_update_mode: str, remat: bool = False):
+           fuse_views: bool, ema_update_mode: str, remat: bool = False,
+           stem: str = "conv"):
     from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
                                       ParityConfig, TaskConfig, resolve)
     from byol_tpu.parallel.mesh import MeshSpec, build_mesh, shard_batch_to_mesh
@@ -92,7 +93,8 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
     cfg = Config(
         task=TaskConfig(task="fake", batch_size=batch_size * n_dev, epochs=100,
                         image_size_override=image_size),
-        model=ModelConfig(arch=arch, fuse_views=fuse_views, remat=remat),
+        model=ModelConfig(arch=arch, fuse_views=fuse_views, remat=remat,
+                          stem=stem),
         device=DeviceConfig(num_replicas=n_dev, half=half, seed=0),
         parity=ParityConfig(ema_update_mode=ema_update_mode),
     )
@@ -115,11 +117,11 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
 
 def _throughput(batch_size: int, image_size: int, arch: str, *, half: bool,
                 fuse_views: bool, ema_update_mode: str, remat: bool = False,
-                steps: int = 20) -> float:
+                stem: str = "conv", steps: int = 20) -> float:
     """Images/sec/chip for one configuration (global images / sec / n_dev)."""
     state, train_step, batch = _build(
         batch_size, image_size, arch, half=half, fuse_views=fuse_views,
-        ema_update_mode=ema_update_mode, remat=remat)
+        ema_update_mode=ema_update_mode, remat=remat, stem=stem)
     # warmup: compile + 2 steady steps.  NB: sync via a scalar READBACK, not
     # block_until_ready — on tunneled platforms (axon) block_until_ready
     # returns at dispatch-ack and wildly overstates throughput; a D2H read
@@ -174,11 +176,22 @@ def _reraise_if_backend_dead(exc: BaseException) -> None:
     msg = str(exc)
     if not any(m in msg for m in _BACKEND_DEAD_MARKERS):
         return
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return  # host backend cannot "die"; the failure is config-local
     import subprocess
+    # The child must prove THIS backend is alive — without the platform
+    # assert, jax's silent CPU fallback would pass the matmul on a dead
+    # accelerator and mislabel the death as did-not-fit (re-poisoning the
+    # ladder, the exact failure this probe exists to prevent).  The child
+    # inherits the normal platform plugin, so a dead accelerator either
+    # hangs it (timeout) or falls back to cpu (assert fires): both nonzero.
     try:
         probe = subprocess.run(
             [sys.executable, "-c",
              "import jax, jax.numpy as jnp; "
+             f"assert jax.default_backend() == {backend!r}, "
+             "jax.default_backend(); "
              "print(float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()))"],
             timeout=60.0, capture_output=True, text=True)
         if probe.returncode == 0:
@@ -190,6 +203,25 @@ def _reraise_if_backend_dead(exc: BaseException) -> None:
         "a backend-death marker and a 60s probe matmul failed); aborting "
         "the remaining configs (already-measured results are preserved in "
         f"{_PARTIAL_PATH})") from exc
+
+
+def _config_failed(context: str, exc: BaseException) -> bool:
+    """Shared per-config failure path: classify, log, record.
+
+    Returns True if the backend is dead (recorded via _note_backend_dead;
+    the caller must stop measuring), False for an ordinary did-not-fit
+    (logged; the caller records ``fit=False`` and steps the ladder down).
+    """
+    try:
+        _reraise_if_backend_dead(exc)
+    except BackendDied:
+        traceback.print_exc()
+        _note_backend_dead(context)
+        return True
+    print(f"bench: {context} failed (treating as did-not-fit):",
+          file=sys.stderr)
+    traceback.print_exc()
+    return False
 
 
 def _flush_partial():
@@ -281,15 +313,8 @@ def main():
             try:
                 val = _throughput(bs, image_size, arch, **kw)
             except Exception as e:
-                try:
-                    _reraise_if_backend_dead(e)
-                except BackendDied:
-                    traceback.print_exc()
-                    _note_backend_dead(f"config={name} bs/chip={bs}")
+                if _config_failed(f"config={name} bs/chip={bs}", e):
                     break
-                print(f"bench: config={name} bs/chip={bs} failed "
-                      f"(treating as did-not-fit):", file=sys.stderr)
-                traceback.print_exc()
                 _record(name, batch_per_chip=bs, fit=False)
                 continue
             _record(name, batch_per_chip=bs, fit=True,
@@ -301,6 +326,24 @@ def main():
                 break
         return best
 
+    if "--stem-ab" in sys.argv[1:]:
+        # A/B the headline config's stem: plain 7x7/2 conv vs the
+        # space-to-depth rearrangement (identical numerics; layout only).
+        if not on_tpu:
+            raise SystemExit(
+                "bench: --stem-ab needs the TPU config — the CPU fallback "
+                "(resnet18@32) uses the CIFAR stem, where the stem knob is "
+                "inert and an A/B would compare identical models")
+        for stem in ("conv", "space_to_depth"):
+            val = best_throughput(f"stem_{stem}", half=True, fuse_views=True,
+                                  ema_update_mode="post", stem=stem)
+            print(json.dumps({"metric": f"stem_ab_{stem}",
+                              "value": round(val, 2) if val else None,
+                              "unit": "images/sec/chip",
+                              "vs_baseline": None,
+                              "mfu": (round(mfu_of(val), 4)
+                                      if val and mfu_of(val) else None)}))
+        return
     if "--sweep" in sys.argv[1:]:
         _sweep(arch, image_size, candidates, mfu_of)
         return
@@ -355,10 +398,9 @@ def _profile(arch, image_size, candidates, logdir):
                                       fuse_views=True,
                                       ema_update_mode="post", steps=5), bs))
         except Exception as e:
-            _reraise_if_backend_dead(e)  # dead backend: nothing to trace
-            print(f"bench: profile bs={bs} failed (treating as "
-                  f"did-not-fit):", file=sys.stderr)
-            traceback.print_exc()
+            if _config_failed(f"profile bs={bs}", e):
+                raise SystemExit(
+                    "bench: backend died during --profile; nothing to trace")
             continue
         if len(rates) >= 2:
             break
@@ -395,14 +437,8 @@ def _sweep(arch, image_size, candidates, mfu_of):
                                       fuse_views=fuse, remat=remat,
                                       ema_update_mode="post", steps=10)
                 except Exception as e:
-                    try:
-                        _reraise_if_backend_dead(e)
-                    except BackendDied:
-                        traceback.print_exc()
-                        _note_backend_dead(name)
+                    if _config_failed(name, e):
                         break
-                    print(f"bench: {name} failed:", file=sys.stderr)
-                    traceback.print_exc()
                     _record(name, batch_per_chip=bs, fit=False)
                     continue
                 row = {"batch_per_chip": bs, "remat": remat,

@@ -144,6 +144,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "changes BN batch statistics vs the reference)")
     x.add_argument("--remat", action="store_true",
                    help="checkpoint the encoder (HBM for FLOPs)")
+    x.add_argument("--stem", type=str, default="conv",
+                   choices=("conv", "space_to_depth"),
+                   help="resnet stem: space_to_depth computes the 7x7/2 "
+                        "conv as an MXU-friendly 4x4/1 rearrangement "
+                        "(identical numerics and checkpoints)")
     x.add_argument("--attn-impl", type=str, default="dense",
                    choices=("dense", "flash", "ring"),
                    help="ViT attention backend")
@@ -194,6 +199,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             weight_initialization=args.weight_initialization,
             model_dir=args.model_dir,
             fuse_views=args.fuse_views, remat=args.remat,
+            stem=args.stem,
             attn_impl=args.attn_impl, pooling=args.pooling),
         regularizer=RegularizerConfig(
             color_jitter_strength=args.color_jitter_strength,

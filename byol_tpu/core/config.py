@@ -69,6 +69,9 @@ class ModelConfig:
                                         # so off by default; turn on for perf.
     remat: bool = False                 # jax.checkpoint the encoder to trade
                                         # FLOPs for HBM.
+    stem: str = "conv"                  # resnet stem: 'conv' (7x7/2) or
+                                        # 'space_to_depth' (identical numerics,
+                                        # MXU-friendly 4x4/1 rearrangement).
     attn_impl: str = "dense"            # ViT attention backend: 'dense'
                                         # (XLA), 'flash' (Pallas), 'ring'
                                         # (sequence-parallel over the mesh).

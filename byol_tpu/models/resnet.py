@@ -20,9 +20,53 @@ import functools
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
+
+
+class SpaceToDepthStem(nn.Module):
+    """The 7x7/2 stem conv, computed as a 4x4/1 conv on space-to-depth input.
+
+    Mathematically IDENTICAL to ``Conv(width, (7,7), (2,2), padding=3)`` —
+    the kernel is zero-padded to 8x8 and rearranged so each output position
+    reads the same input window — but far friendlier to the TPU: the
+    stride-2 7x7 conv over 3 input channels starves the MXU (3 channels
+    against 128 lanes, and the stride halves useful overlap), while the
+    rearranged form is a dense stride-1 conv over 12 channels on half the
+    spatial extent.  This is the standard MLPerf ResNet trick, built here
+    as a reparametrization: the PARAM is still the (7,7,C,width) kernel
+    (same init distribution, same checkpoint tree as the plain stem —
+    ``params/stem_conv/kernel``), and the rearrangement happens at apply
+    time where XLA folds it into the conv.
+    """
+
+    width: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        if h % 2 or w % 2:
+            raise ValueError(
+                f"space_to_depth stem needs even spatial dims, got {(h, w)}")
+        kernel = self.param("kernel", nn.initializers.he_normal(),
+                            (7, 7, c, self.width), jnp.float32)
+        x, kernel = nn.dtypes.promote_dtype(x, kernel, dtype=self.dtype)
+        # Zero row/col at the FRONT: output i of the original conv reads
+        # input rows 2i-3..2i+3; over 2x2 subpixel blocks that window is
+        # rows -1..6 of an 8x8 kernel whose first row/col never fires.
+        k = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        # (8,8,C,O) -> (R,pr,S,pc,C,O) -> (R,S,pr,pc,C,O) -> (4,4,4C,O)
+        k = k.reshape(4, 2, 4, 2, c, self.width).transpose(0, 2, 1, 3, 4, 5)
+        k = k.reshape(4, 4, 4 * c, self.width)
+        # input space-to-depth with the matching (pr,pc,c) channel order
+        x = x.reshape(b, h // 2, 2, w // 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
+        x = x.reshape(b, h // 2, w // 2, 4 * c)
+        return jax.lax.conv_general_dilated(
+            x, k, window_strides=(1, 1), padding=((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 class BasicBlock(nn.Module):
@@ -101,6 +145,9 @@ class ResNet(nn.Module):
     remat: bool = False                  # jax.checkpoint each residual block
                                          # (recompute activations in backward:
                                          # HBM for FLOPs)
+    stem: str = "conv"                   # 'conv' | 'space_to_depth' (identical
+                                         # numerics, MXU-friendly layout;
+                                         # ignored for the CIFAR stem)
 
     @property
     def feature_dim(self) -> int:
@@ -119,8 +166,14 @@ class ResNet(nn.Module):
                                  epsilon=self.bn_epsilon)
         if self.small_inputs:
             x = conv(self.width, (3, 3), padding=1, name="stem_conv")(x)
-        else:
+        elif self.stem == "space_to_depth":
+            x = SpaceToDepthStem(self.width, dtype=self.dtype,
+                                 name="stem_conv")(x)
+        elif self.stem == "conv":
             x = conv(self.width, (7, 7), (2, 2), padding=3, name="stem_conv")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}; "
+                             "'conv' | 'space_to_depth'")
         x = norm(name="stem_bn")(x)
         x = nn.relu(x)
         if not self.small_inputs:
@@ -151,7 +204,7 @@ BASIC = {"resnet18", "resnet34"}
 def make_resnet(name: str, *, dtype=jnp.float32, width_multiplier: int = 1,
                 small_inputs: bool = False,
                 zero_init_residual: bool = True,
-                remat: bool = False) -> ResNet:
+                remat: bool = False, stem: str = "conv") -> ResNet:
     base = name.replace("w2", "")
     if base not in STAGE_SIZES:
         raise ValueError(f"unknown resnet arch {name!r}; "
@@ -163,4 +216,4 @@ def make_resnet(name: str, *, dtype=jnp.float32, width_multiplier: int = 1,
                   width=64 * width_multiplier, dtype=dtype,
                   small_inputs=small_inputs,
                   zero_init_residual=zero_init_residual,
-                  remat=remat)
+                  remat=remat, stem=stem)

@@ -36,7 +36,8 @@ def build_net(rcfg: ResolvedConfig) -> BYOLNet:
     from byol_tpu.models.registry import get_spec
     if get_spec(cfg.model.arch).has_batchnorm:
         extra = {"zero_init_residual": cfg.parity.zero_init_residual,
-                 "remat": cfg.model.remat}
+                 "remat": cfg.model.remat,
+                 "stem": cfg.model.stem}
     else:  # ViT-family knobs
         extra = {"remat": cfg.model.remat,
                  "attn_impl": cfg.model.attn_impl,

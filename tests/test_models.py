@@ -51,6 +51,35 @@ class TestResNet:
         out_eval = m.apply(variables, x, train=False, mutable=False)
         assert out_eval.shape == (4, 512)
 
+    def test_space_to_depth_stem_matches_conv_stem_exactly(self):
+        # The s2d stem is a pure reparametrization of the 7x7/2 conv: same
+        # param tree (params/stem_conv/kernel, (7,7,3,w)), same outputs,
+        # same gradients — so checkpoints are interchangeable between stems.
+        conv_net = make_resnet("resnet18", stem="conv")
+        s2d_net = make_resnet("resnet18", stem="space_to_depth")
+        x = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        variables = conv_net.init(jax.random.PRNGKey(0), x, train=False)
+        k_shape = variables["params"]["stem_conv"]["kernel"].shape
+        assert k_shape == (7, 7, 3, 64)
+        out_conv = conv_net.apply(variables, x, train=False, mutable=False)
+        out_s2d = s2d_net.apply(variables, x, train=False, mutable=False)
+        assert jnp.max(jnp.abs(out_conv - out_s2d)) < 1e-4
+
+        def loss(net):
+            return lambda v: jnp.sum(
+                net.apply(v, x, train=False, mutable=False) ** 2)
+        g_conv = jax.grad(loss(conv_net))(variables)
+        g_s2d = jax.grad(loss(s2d_net))(variables)
+        gk_conv = g_conv["params"]["stem_conv"]["kernel"]
+        gk_s2d = g_s2d["params"]["stem_conv"]["kernel"]
+        assert jnp.max(jnp.abs(gk_conv - gk_s2d)) < 1e-3
+
+    def test_space_to_depth_stem_rejects_odd_spatial(self):
+        m = make_resnet("resnet18", stem="space_to_depth")
+        with pytest.raises(ValueError, match="even spatial"):
+            m.init(jax.random.PRNGKey(0), jnp.zeros((1, 33, 33, 3)),
+                   train=False)
+
 
 class TestHeads:
     def test_mlp_head_shapes(self):
