@@ -224,8 +224,21 @@ def _config_failed(context: str, exc: BaseException) -> bool:
     return False
 
 
+_flushed_paths: set = set()
+
+
 def _flush_partial():
     try:
+        # A fresh run must never DESTROY prior evidence: the first write of
+        # this process moves any existing file to <path>.prev instead of
+        # truncating it.  (Learned the hard way: an import-time classifier
+        # check once overwrote the committed TPU artifact with a single
+        # backend_died stub.)
+        import os
+        if _PARTIAL_PATH not in _flushed_paths:
+            _flushed_paths.add(_PARTIAL_PATH)
+            if os.path.exists(_PARTIAL_PATH):
+                os.replace(_PARTIAL_PATH, _PARTIAL_PATH + ".prev")
         with open(_PARTIAL_PATH, "w") as f:
             json.dump(_partial, f, indent=2)
             f.write("\n")

@@ -1,0 +1,102 @@
+"""Summarize a jax.profiler trace: top ops by total device time.
+
+Usage: python scripts/trace_top_ops.py /tmp/byol_profile [N]
+
+Reads the newest ``*.trace.json.gz`` under the logdir (the TensorBoard
+profile plugin layout ``plugins/profile/<ts>/``), aggregates complete events
+on device OP tracks by name, and prints the top-N ops with total time and
+share of the trace's device-busy time.  When the trace carries per-thread
+names (jax traces name them "XLA Ops" / "XLA Modules" / "Steps"), only the
+op threads are aggregated — module/step region events span their children
+and would otherwise double-count.  This turns ``bench.py --profile`` output
+into the tuning table RESULTS.md wants (where does non-conv time go)
+without needing a TensorBoard UI, which this headless box lacks.
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def find_trace(logdir: str) -> str:
+    pats = [os.path.join(logdir, "plugins", "profile", "*", "*.trace.json.gz"),
+            os.path.join(logdir, "**", "*.trace.json.gz")]
+    hits: list[str] = []
+    for p in pats:
+        hits = glob.glob(p, recursive=True)
+        if hits:
+            break
+    if not hits:
+        raise SystemExit(f"no *.trace.json.gz under {logdir}")
+    return max(hits, key=os.path.getmtime)
+
+
+def summarize(trace_path: str, top_n: int = 30):
+    with gzip.open(trace_path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    # pid -> process name; device tracks are the TPU/accelerator pids
+    pid_names = {}
+    tid_names = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pid_names[e["pid"]] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            tid_names[(e["pid"], e.get("tid"))] = \
+                e.get("args", {}).get("name", "")
+    device_pids = {pid for pid, name in pid_names.items()
+                   if any(k in name.lower()
+                          for k in ("tpu", "device", "xla", "accelerator"))
+                   and "host" not in name.lower()}
+    if not device_pids:   # fall back to every non-host pid
+        device_pids = {pid for pid, name in pid_names.items()
+                       if "host" not in name.lower()}
+    # Module/step region events contain their child ops; keep only the op
+    # threads when the trace names threads, else take everything.
+    op_tids = {k for k, name in tid_names.items()
+               if k[0] in device_pids and "op" in name.lower()}
+
+    def on_op_track(e):
+        if e.get("pid") not in device_pids:
+            return False
+        return not op_tids or (e["pid"], e.get("tid")) in op_tids
+
+    total = collections.Counter()
+    count = collections.Counter()
+    busy = 0.0
+    for e in events:
+        if e.get("ph") != "X" or not on_op_track(e):
+            continue
+        dur = float(e.get("dur", 0.0))   # microseconds
+        name = e.get("name", "?")
+        total[name] += dur
+        count[name] += 1
+        busy += dur
+    rows = [(t, count[n], n) for n, t in total.most_common(top_n)]
+    return rows, busy
+
+
+def main():
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    logdir = sys.argv[1]
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    path = find_trace(logdir)
+    rows, busy = summarize(path, top_n)
+    print(f"trace: {path}")
+    print(f"device-busy time: {busy / 1e3:.2f} ms (sum over op events; "
+          "totals and shares double-count if ops overlap on parallel "
+          "tracks)")
+    print(f"{'total_ms':>10} {'calls':>7} {'share':>7}  op")
+    for t, c, name in rows:
+        print(f"{t / 1e3:>10.3f} {c:>7} {t / busy:>6.1%}  {name[:100]}")
+
+
+if __name__ == "__main__":
+    main()
