@@ -1,27 +1,30 @@
 #!/usr/bin/env bash
-# Round-3 TPU capture: headline bench, tuning sweep, profile trace, synth
-# learning run.  Differs from tpu_evidence.sh in that it preserves each
-# stage's bench_partial.json (every bench.py invocation rewrites that file)
-# and tees all stdout/stderr to /tmp logs for post-hoc analysis.
+# Round-3 TPU capture.  Differs from tpu_evidence.sh in that it preserves
+# each stage's bench_partial.json (every bench.py invocation rewrites that
+# file) and tees all stdout/stderr to /tmp logs for post-hoc analysis.
+# Stage order puts NEW information first (the tunnel can drop at any time);
+# the headline re-run goes last, where the sweep has already populated the
+# persistent compile cache with its exact configs.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p /tmp/tpu_capture
 
-echo "== 1/4 headline bench =="
-python bench.py > /tmp/tpu_capture/headline_stdout.json 2> /tmp/tpu_capture/headline_stderr.log
-echo "rc=$?"
-cp -f bench_partial.json /tmp/tpu_capture/headline_partial.json 2>/dev/null
-
-echo "== 2/4 sweep =="
+echo "== 1/5 sweep =="
 python bench.py --sweep > /tmp/tpu_capture/sweep_stdout.json 2> /tmp/tpu_capture/sweep_stderr.log
 echo "rc=$?"
 cp -f bench_partial.json /tmp/tpu_capture/sweep_partial.json 2>/dev/null
 
-echo "== 3/4 profile =="
+echo "== 2/5 stem A/B =="
+python bench.py --stem-ab > /tmp/tpu_capture/stem_ab_stdout.json 2> /tmp/tpu_capture/stem_ab_stderr.log
+echo "rc=$?"
+cp -f bench_partial.json /tmp/tpu_capture/stem_ab_partial.json 2>/dev/null
+
+echo "== 3/5 profile =="
 python bench.py --profile /tmp/byol_profile > /tmp/tpu_capture/profile_stdout.json 2> /tmp/tpu_capture/profile_stderr.log
 echo "rc=$?"
+python scripts/trace_top_ops.py /tmp/byol_profile 40 > /tmp/tpu_capture/trace_top_ops.txt 2>&1
 
-echo "== 4/4 synth learning evidence =="
+echo "== 4/5 synth learning evidence =="
 python train.py --task synth --batch-size 512 --epochs 12 \
     --arch resnet18 --image-size-override 32 --head-latent-size 512 \
     --projection-size 128 --lr 0.8 --warmup 2 --fuse-views \
@@ -29,4 +32,9 @@ python train.py --task synth --batch-size 512 --epochs 12 \
     --log-dir runs --model-dir /tmp/synth_models \
     > /tmp/tpu_capture/synth_stdout.log 2> /tmp/tpu_capture/synth_stderr.log
 echo "rc=$?"
+
+echo "== 5/5 headline bench =="
+python bench.py > /tmp/tpu_capture/headline_stdout.json 2> /tmp/tpu_capture/headline_stderr.log
+echo "rc=$?"
+cp -f bench_partial.json /tmp/tpu_capture/headline_partial.json 2>/dev/null
 echo "== capture done =="

@@ -95,7 +95,8 @@ def main():
           "tracks)")
     print(f"{'total_ms':>10} {'calls':>7} {'share':>7}  op")
     for t, c, name in rows:
-        print(f"{t / 1e3:>10.3f} {c:>7} {t / busy:>6.1%}  {name[:100]}")
+        share = f"{t / busy:>6.1%}" if busy > 0 else "   n/a"
+        print(f"{t / 1e3:>10.3f} {c:>7} {share}  {name[:100]}")
 
 
 if __name__ == "__main__":
