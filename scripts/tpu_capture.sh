@@ -3,8 +3,10 @@
 # each stage's bench_partial.json (every bench.py invocation rewrites that
 # file) and tees all stdout/stderr to /tmp logs for post-hoc analysis.
 # Stage order puts NEW information first (the tunnel can drop at any time);
-# the headline re-run goes last, where the sweep has already populated the
-# persistent compile cache with its exact configs.
+# the headline re-run goes last: its tpu_first ladder is compile-cached by
+# the sweep, though its fp32 reference_faithful baseline is NOT in the
+# sweep grid and still compiles cold — if the tunnel dies before stage 5,
+# the committed bench_partial.json already carries a full headline run.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p /tmp/tpu_capture
@@ -20,9 +22,13 @@ echo "rc=$?"
 cp -f bench_partial.json /tmp/tpu_capture/stem_ab_partial.json 2>/dev/null
 
 echo "== 3/5 profile =="
+rm -rf /tmp/byol_profile   # a stale trace must not masquerade as this run's
 python bench.py --profile /tmp/byol_profile > /tmp/tpu_capture/profile_stdout.json 2> /tmp/tpu_capture/profile_stderr.log
-echo "rc=$?"
-python scripts/trace_top_ops.py /tmp/byol_profile 40 > /tmp/tpu_capture/trace_top_ops.txt 2>&1
+profile_rc=$?
+echo "rc=$profile_rc"
+if [ "$profile_rc" -eq 0 ]; then
+    python scripts/trace_top_ops.py /tmp/byol_profile 40 > /tmp/tpu_capture/trace_top_ops.txt 2>&1
+fi
 
 echo "== 4/5 synth learning evidence =="
 python train.py --task synth --batch-size 512 --epochs 12 \
