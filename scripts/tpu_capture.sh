@@ -28,6 +28,9 @@ profile_rc=$?
 echo "rc=$profile_rc"
 if [ "$profile_rc" -eq 0 ]; then
     python scripts/trace_top_ops.py /tmp/byol_profile 40 > /tmp/tpu_capture/trace_top_ops.txt 2>&1
+else
+    # a stale table from a previous capture must not survive a failed stage
+    echo "profile failed rc=$profile_rc; no trace" > /tmp/tpu_capture/trace_top_ops.txt
 fi
 
 echo "== 4/5 synth learning evidence =="

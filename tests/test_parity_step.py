@@ -1,0 +1,152 @@
+"""Full head-path per-step numerical parity vs torch.
+
+SURVEY.md §4 calls for a reference-vs-new per-step parity harness.  The
+reference's backbone comes from torchvision (not installed here), but every
+line of its own first-party math — projector/predictor MLPs with BN1d
+(main.py:194-205), the symmetrized whole-tensor-Frobenius loss
+(objective.py:6-25), backward, SGD-momentum step, and the EMA target update
+(main.py:159-162) — is reproduced in torch IN THIS TEST and compared
+against the byol_tpu implementation on identical weights and a fixed
+feature batch: loss, gradients, post-step parameters, EMA'd target
+parameters, and BN running statistics must all agree.
+
+The single deliberate delta this pins: torch's BatchNorm updates running_var
+with the UNBIASED batch variance while flax uses the biased one — the test
+asserts the exact B/(B-1) relationship rather than papering over it.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn as tnn
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from byol_tpu.models.heads import MLPHead
+from byol_tpu.objectives.byol_loss import loss_function
+
+F_IN, HID, OUT, B = 16, 32, 8, 12
+LR, MOM, TAU = 0.1, 0.9, 0.99
+
+
+def _torch_head(in_dim):
+    return tnn.Sequential(tnn.Linear(in_dim, HID), tnn.BatchNorm1d(HID),
+                          tnn.ReLU(), tnn.Linear(HID, OUT))
+
+
+def _to_flax(seq):
+    """torch Sequential(Linear, BN1d, ReLU, Linear) -> MLPHead variables."""
+    def w(t):
+        return jnp.asarray(t.detach().numpy())
+    l1, bn, _, l2 = seq
+    params = {"dense1": {"kernel": w(l1.weight).T, "bias": w(l1.bias)},
+              "bn": {"scale": w(bn.weight), "bias": w(bn.bias)},
+              "dense2": {"kernel": w(l2.weight).T, "bias": w(l2.bias)}}
+    stats = {"bn": {"mean": w(bn.running_mean), "var": w(bn.running_var)}}
+    return params, stats
+
+
+def _flax_forward(head, params, stats, x1, x2):
+    """Both views through one head, chaining BN running-stat updates the way
+    two sequential torch forward calls do."""
+    o1, upd = head.apply({"params": params, "batch_stats": stats}, x1,
+                         train=True, mutable=["batch_stats"])
+    o2, upd = head.apply({"params": params,
+                          "batch_stats": upd["batch_stats"]}, x2,
+                         train=True, mutable=["batch_stats"])
+    return o1, o2, upd["batch_stats"]
+
+
+class TestHeadPathStepParity:
+    def test_loss_grads_step_ema_and_bn_stats_match_torch(self):
+        torch.manual_seed(0)
+        rng = np.random.RandomState(0)
+        f1 = rng.rand(B, F_IN).astype(np.float32)
+        f2 = rng.rand(B, F_IN).astype(np.float32)
+
+        # ---- torch reference step (main.py semantics) --------------------
+        proj, pred, tproj = _torch_head(F_IN), _torch_head(OUT), \
+            _torch_head(F_IN)
+        p1 = pred(proj(torch.from_numpy(f1)))
+        p2 = pred(proj(torch.from_numpy(f2)))
+        with torch.no_grad():       # target branch: train-mode BN, no grads
+            t1 = tproj(torch.from_numpy(f1))
+            t2 = tproj(torch.from_numpy(f2))
+
+        def reg(x, y):              # objective.py:6-10 (whole-tensor norms)
+            return -2.0 * (x * y).sum(-1) / (x.norm() * y.norm())
+
+        loss_t = (reg(p1, t2) + reg(p2, t1)).mean()
+        opt = torch.optim.SGD(list(proj.parameters())
+                              + list(pred.parameters()), lr=LR, momentum=MOM)
+        loss_t.backward()
+        grad_t = proj[0].weight.grad.detach().numpy().copy()
+        opt.step()
+        with torch.no_grad():       # EMA with post-update params
+            for tp, p in zip(tproj.parameters(), proj.parameters()):
+                tp.mul_(TAU).add_((1.0 - TAU) * p)
+
+        # ---- byol_tpu step on identical initial weights ------------------
+        torch.manual_seed(0)        # rebuild the SAME initial nets
+        proj0, pred0, tproj0 = _torch_head(F_IN), _torch_head(OUT), \
+            _torch_head(F_IN)
+        head = MLPHead(hidden_size=HID, output_size=OUT)
+        pp, pbs = _to_flax(proj0)
+        rp, rbs = _to_flax(pred0)
+        tp_, tbs = _to_flax(tproj0)
+        j1, j2 = jnp.asarray(f1), jnp.asarray(f2)
+
+        tproj1, tproj2, _ = _flax_forward(head, tp_, tbs, j1, j2)
+
+        def loss_fn(trainable):
+            q1, q2, new_pbs = _flax_forward(
+                head, trainable["proj"], pbs, j1, j2)
+            # predictor sees each view separately, stats chained like torch
+            o1, upd = head.apply(
+                {"params": trainable["pred"], "batch_stats": rbs}, q1,
+                train=True, mutable=["batch_stats"])
+            o2, upd = head.apply(
+                {"params": trainable["pred"],
+                 "batch_stats": upd["batch_stats"]}, q2,
+                train=True, mutable=["batch_stats"])
+            loss = loss_function(o1, o2, tproj1, tproj2,
+                                 norm_mode="reference")
+            return loss, (new_pbs, upd["batch_stats"])
+
+        trainable = {"proj": pp, "pred": rp}
+        (loss_j, (new_pbs, _)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(trainable)
+        tx = optax.sgd(LR, momentum=MOM)
+        updates, _ = tx.update(grads, tx.init(trainable), trainable)
+        new_trainable = optax.apply_updates(trainable, updates)
+        new_tp = jax.tree_util.tree_map(
+            lambda t, p: TAU * t + (1.0 - TAU) * p,
+            tp_, new_trainable["proj"])
+
+        # ---- parity assertions ------------------------------------------
+        assert float(loss_j) == pytest.approx(float(loss_t.detach()), abs=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(grads["proj"]["dense1"]["kernel"]).T, grad_t,
+            atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(new_trainable["proj"]["dense1"]["kernel"]).T,
+            proj[0].weight.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(new_trainable["pred"]["dense2"]["bias"]),
+            pred[3].bias.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(new_tp["dense1"]["kernel"]).T,
+            tproj[0].weight.detach().numpy(), atol=1e-5)
+
+        # BN running mean matches exactly; running var differs ONLY by the
+        # documented biased-vs-unbiased delta: both are 0.9^2*1 + linear
+        # combinations of per-view batch variances, torch's scaled by
+        # B/(B-1).  So flax_var = (torch_var - 0.9^2) * (B-1)/B + 0.9^2.
+        np.testing.assert_allclose(
+            np.asarray(new_pbs["bn"]["mean"]),
+            proj[1].running_mean.detach().numpy(), atol=1e-5)
+        torch_var = proj[1].running_var.detach().numpy()
+        expected_flax_var = (torch_var - 0.81) * (B - 1) / B + 0.81
+        np.testing.assert_allclose(
+            np.asarray(new_pbs["bn"]["var"]), expected_flax_var, atol=1e-5)
