@@ -1,0 +1,71 @@
+"""Multi-step LARS parity vs an independent torch implementation.
+
+`tests/test_optim.py` pins single-step LARS behavior against hand-computed
+values; this test runs THREE steps against a torch implementation of the
+reference's documented semantics (SURVEY.md C5; optimizers/lars.py:88-126:
+wd folded into the grad BEFORE the trust ratio, adaptation skipped for
+`ignore` (bias/BN) groups, trust ratio applied only when both norms > 0,
+inner SGD-momentum with its own wd zeroed) — so the momentum-buffer
+interaction across steps, not just one update, is confirmed against an
+executable independent oracle.
+"""
+import numpy as np
+import torch
+
+import jax.numpy as jnp
+import optax
+
+from byol_tpu.optim.lars import lars
+
+LR, MOM, WD, TRUST, EPS, STEPS = 0.1, 0.9, 1e-2, 1e-3, 0.0, 3
+
+
+def _torch_lars_trajectory(k0, b0, grads):
+    """Reference-semantics LARS+SGD(momentum) in torch, from the SURVEY
+    behavioral contract (not a code copy): returns params after each step."""
+    kernel = torch.tensor(k0.copy())
+    bias = torch.tensor(b0.copy())
+    buf = {"kernel": torch.zeros_like(kernel),
+           "bias": torch.zeros_like(bias)}
+    out = []
+    for gk, gb in grads:
+        gk = torch.tensor(gk.copy())
+        gb = torch.tensor(gb.copy())
+        # 1) fold wd into the kernel grad BEFORE adaptation (bias group
+        #    carries wd=0 per the add_weight_decay contract)
+        gk = gk + WD * kernel
+        # 2-3) trust ratio on the kernel only, gated on both norms > 0
+        pn, gn = kernel.norm(), gk.norm()
+        if pn > 0 and gn > 0:
+            gk = gk * (TRUST * pn / (gn + EPS))
+        # 4) inner SGD-momentum with wd zeroed
+        buf["kernel"] = MOM * buf["kernel"] + gk
+        buf["bias"] = MOM * buf["bias"] + gb
+        kernel = kernel - LR * buf["kernel"]
+        bias = bias - LR * buf["bias"]
+        out.append((kernel.numpy().copy(), bias.numpy().copy()))
+    return out
+
+
+class TestLarsMultiStepParity:
+    def test_three_steps_match_torch_oracle(self):
+        rng = np.random.RandomState(0)
+        k0 = rng.randn(4, 3).astype(np.float32)
+        b0 = rng.randn(3).astype(np.float32)
+        grads = [(rng.randn(4, 3).astype(np.float32),
+                  rng.randn(3).astype(np.float32)) for _ in range(STEPS)]
+
+        expected = _torch_lars_trajectory(k0, b0, grads)
+
+        params = {"kernel": jnp.asarray(k0), "bias": jnp.asarray(b0)}
+        tx = lars(optax.sgd(LR, momentum=MOM), weight_decay=WD,
+                  trust_coefficient=TRUST, eps=EPS)
+        state = tx.init(params)
+        for (gk, gb), (ek, eb) in zip(grads, expected):
+            g = {"kernel": jnp.asarray(gk), "bias": jnp.asarray(gb)}
+            updates, state = tx.update(g, state, params)
+            params = optax.apply_updates(params, updates)
+            np.testing.assert_allclose(np.asarray(params["kernel"]), ek,
+                                       atol=1e-6)
+            np.testing.assert_allclose(np.asarray(params["bias"]), eb,
+                                       atol=1e-6)
