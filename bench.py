@@ -253,15 +253,16 @@ def _record(name: str, **fields):
     _flush_partial()
 
 
-def _preflight_backend(timeout_s: float = 180.0) -> None:
+def _preflight_backend(timeout_s: float = 180.0) -> bool:
     """Probe backend initialization in a KILLABLE subprocess first.
 
     A SIGTERM-killed TPU run can wedge the axon tunnel for hours, after
     which backend init blocks forever inside C — un-interruptible from this
     process.  Probing in a subprocess turns an unattended infinite hang
-    into a fast, explained failure."""
+    into a fast, explained failure.  Returns False (with the diagnosis on
+    stderr) when the accelerator is unreachable."""
     if jax.config.jax_platforms == "cpu":
-        return   # explicitly pinned to CPU (tests/smokes): nothing to probe
+        return True  # explicitly pinned to CPU (tests/smokes): no probe
     import subprocess
     try:
         probe = subprocess.run(
@@ -270,14 +271,65 @@ def _preflight_backend(timeout_s: float = 180.0) -> None:
              "print(float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()))"],
             timeout=timeout_s, capture_output=True, text=True)
     except subprocess.TimeoutExpired:
-        raise SystemExit(
-            f"bench: backend failed to initialize within {timeout_s:.0f}s — "
-            "the TPU tunnel is likely wedged (a previously killed TPU "
-            "process leaves it hung for hours). No measurement possible; "
-            "rerun when a probe matmul succeeds.")
+        print(f"bench: backend failed to initialize within {timeout_s:.0f}s "
+              "— the TPU tunnel is likely wedged (a previously killed TPU "
+              "process leaves it hung for hours).", file=sys.stderr)
+        return False
     if probe.returncode != 0:
+        print("bench: backend probe failed:\n" + probe.stderr[-2000:],
+              file=sys.stderr)
+        return False
+    return True
+
+
+def _emit_stale_or_die() -> None:
+    """Backend unreachable: fall back to the last COMMITTED TPU measurement,
+    explicitly marked stale, rather than dying with no parseable output.
+    The driver records bench stdout every round; a third rc=1 round would
+    carry less information than the honest 'here is the last real TPU
+    number, the chip was unreachable at capture time'."""
+    last_err, prior, best, best_base, src = None, None, None, None, None
+    # The live file may have been rotated to .prev by an intervening run
+    # (e.g. a sweep) that recorded no tpu_first rows — consult both.
+    for path in (_PARTIAL_PATH, _PARTIAL_PATH + ".prev"):
+        try:
+            with open(path) as f:
+                cand = json.load(f)
+            if "tpu" not in str(cand.get("device_kind", "")).lower():
+                raise ValueError(f"no TPU results in {path}")
+            fits = [r for r in cand["results"]
+                    if r.get("config") == "tpu_first" and r.get("fit")]
+            base = [r for r in cand["results"]
+                    if r.get("config") == "reference_faithful"
+                    and r.get("fit")]
+            best = max(fits, key=lambda r: r["images_per_sec_per_chip"])
+            best_base = (max(base,
+                             key=lambda r: r["images_per_sec_per_chip"])
+                         if base else None)
+            prior, src = cand, path
+            break
+        except Exception as e:
+            last_err = e
+    if prior is None:
         raise SystemExit(
-            "bench: backend probe failed:\n" + probe.stderr[-2000:])
+            "bench: accelerator unreachable and no committed TPU artifact "
+            f"to fall back to ({last_err}); rerun when a probe matmul "
+            "succeeds.")
+    arch = prior.get("arch", "resnet50")
+    value = best["images_per_sec_per_chip"]
+    print(json.dumps({
+        "metric": f"{arch}_byol_train_images_per_sec_per_chip",
+        "value": value,
+        "unit": "images/sec/chip",
+        "vs_baseline": (round(value / best_base["images_per_sec_per_chip"], 3)
+                        if best_base else None),
+        "mfu": (round(best["mfu"], 4)
+                if best.get("mfu") is not None else None),
+        "stale": True,
+        "note": ("accelerator backend unreachable at capture time; value is "
+                 f"the last committed TPU measurement from {src} "
+                 f"({prior.get('device_kind')})"),
+    }))
 
 
 def main():
@@ -287,7 +339,17 @@ def main():
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    _preflight_backend()
+    if not _preflight_backend():
+        mode = {"--sweep", "--profile", "--stem-ab"} & set(sys.argv[1:])
+        if mode:
+            # only the headline has a committed artifact to fall back to;
+            # a stale headline-shaped line in a sweep/profile capture file
+            # would masquerade as that mode's output
+            raise SystemExit(
+                f"bench: accelerator unreachable; {sorted(mode)[0]} needs "
+                "live hardware (no stale fallback for non-headline modes)")
+        _emit_stale_or_die()
+        return
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
         arch, image_size = "resnet50", 224

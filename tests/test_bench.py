@@ -71,6 +71,77 @@ class TestFailureClassification:
         bench._reraise_if_backend_dead(ValueError("shape mismatch"))
 
 
+class TestStaleFallback:
+    """Backend unreachable at capture time -> emit the last committed TPU
+    measurement marked stale (parseable), or die with a clear message when
+    no artifact exists to fall back to."""
+
+    _ARTIFACT = {
+        "results": [
+            {"config": "tpu_first", "batch_per_chip": 256, "fit": True,
+             "images_per_sec_per_chip": 776.11, "mfu": 0.2577},
+            {"config": "reference_faithful", "batch_per_chip": 128,
+             "fit": True, "images_per_sec_per_chip": 495.7, "mfu": 0.165},
+        ],
+        "arch": "resnet50", "device_kind": "TPU v5 lite",
+    }
+
+    def test_emits_stale_committed_measurement(self, bench, capsys):
+        with open("bench_partial.json", "w") as f:
+            json.dump(self._ARTIFACT, f)
+        bench._preflight_backend = lambda *a, **k: False
+        bench.main()
+        out = json.loads(capsys.readouterr().out)
+        assert out["stale"] is True
+        assert out["value"] == 776.11
+        assert out["vs_baseline"] == pytest.approx(1.566, abs=1e-3)
+        assert "unreachable" in out["note"]
+
+    def test_dies_without_tpu_artifact(self, bench):
+        bench._preflight_backend = lambda *a, **k: False
+        with pytest.raises(SystemExit, match="no committed TPU artifact"):
+            bench.main()
+
+    def test_falls_back_to_prev_after_rotation(self, bench):
+        # an intervening run (e.g. a sweep) rotates the committed artifact
+        # to .prev and fills the live file with rows the fallback can't
+        # use — the .prev measurement must still be found
+        with open("bench_partial.json.prev", "w") as f:
+            json.dump(self._ARTIFACT, f)
+        with open("bench_partial.json", "w") as f:
+            json.dump({"results": [{"config": "sweep_bs512", "fit": False}],
+                       "device_kind": "TPU v5 lite"}, f)
+        bench._preflight_backend = lambda *a, **k: False
+        import io, contextlib
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            bench.main()
+        out = json.loads(buf.getvalue())
+        assert out["stale"] is True and out["value"] == 776.11
+        assert ".prev" in out["note"]
+
+    def test_non_headline_modes_refuse_stale_fallback(self, bench, capsys):
+        import sys as _sys
+        with open("bench_partial.json", "w") as f:
+            json.dump(self._ARTIFACT, f)
+        bench._preflight_backend = lambda *a, **k: False
+        old = _sys.argv
+        _sys.argv = ["bench.py", "--sweep"]
+        try:
+            with pytest.raises(SystemExit, match="needs live hardware"):
+                bench.main()
+        finally:
+            _sys.argv = old
+
+    def test_cpu_artifact_does_not_masquerade_as_tpu(self, bench):
+        cpu_art = dict(self._ARTIFACT, device_kind="cpu")
+        with open("bench_partial.json", "w") as f:
+            json.dump(cpu_art, f)
+        bench._preflight_backend = lambda *a, **k: False
+        with pytest.raises(SystemExit, match="no committed TPU artifact"):
+            bench.main()
+
+
 class TestMFUAccounting:
     def test_flops_per_sample_uses_8_forward_equivalents(self, bench):
         # 2 online + 2 target fwds + backward(2x) = 8 fwd-images, 2 FLOPs/MAC
