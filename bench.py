@@ -261,14 +261,22 @@ def _preflight_backend(timeout_s: float = 180.0) -> bool:
     process.  Probing in a subprocess turns an unattended infinite hang
     into a fast, explained failure.  Returns False (with the diagnosis on
     stderr) when the accelerator is unreachable."""
-    if jax.config.jax_platforms == "cpu":
+    platforms = str(jax.config.jax_platforms or "")
+    if platforms == "cpu":
         return True  # explicitly pinned to CPU (tests/smokes): no probe
+    # When a non-cpu platform is explicitly configured (e.g. the axon
+    # plugin forces "axon,cpu"), a probe child that lands on cpu means the
+    # accelerator died and jax silently fell back — which must count as
+    # unreachable, not as a healthy backend (the same silent-fallback trap
+    # _reraise_if_backend_dead guards with its platform assert).
+    expect_accel = bool(platforms) and platforms.split(",")[0] != "cpu"
     import subprocess
     try:
         probe = subprocess.run(
             [sys.executable, "-c",
              "import jax, jax.numpy as jnp; "
-             "print(float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()))"],
+             "float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()); "
+             "print(jax.default_backend())"],
             timeout=timeout_s, capture_output=True, text=True)
     except subprocess.TimeoutExpired:
         print(f"bench: backend failed to initialize within {timeout_s:.0f}s "
@@ -279,6 +287,13 @@ def _preflight_backend(timeout_s: float = 180.0) -> bool:
         print("bench: backend probe failed:\n" + probe.stderr[-2000:],
               file=sys.stderr)
         return False
+    child_backend = probe.stdout.strip().splitlines()[-1] if probe.stdout \
+        else ""
+    if expect_accel and child_backend == "cpu":
+        print(f"bench: platforms={platforms!r} configures an accelerator "
+              "but the probe landed on cpu — the accelerator is dead and "
+              "jax silently fell back.", file=sys.stderr)
+        return False
     return True
 
 
@@ -288,7 +303,7 @@ def _emit_stale_or_die() -> None:
     The driver records bench stdout every round; a third rc=1 round would
     carry less information than the honest 'here is the last real TPU
     number, the chip was unreachable at capture time'."""
-    last_err, prior, best, best_base, src = None, None, None, None, None
+    errs, prior, best, best_base, src = [], None, None, None, None
     # The live file may have been rotated to .prev by an intervening run
     # (e.g. a sweep) that recorded no tpu_first rows — consult both.
     for path in (_PARTIAL_PATH, _PARTIAL_PATH + ".prev"):
@@ -309,12 +324,12 @@ def _emit_stale_or_die() -> None:
             prior, src = cand, path
             break
         except Exception as e:
-            last_err = e
+            errs.append(f"{path}: {e}")
     if prior is None:
         raise SystemExit(
             "bench: accelerator unreachable and no committed TPU artifact "
-            f"to fall back to ({last_err}); rerun when a probe matmul "
-            "succeeds.")
+            f"to fall back to ({'; '.join(errs)}); rerun when a probe "
+            "matmul succeeds.")
     arch = prior.get("arch", "resnet50")
     value = best["images_per_sec_per_chip"]
     print(json.dumps({

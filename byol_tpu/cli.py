@@ -48,6 +48,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="metric writer(s); the reference's visdom|TB switch "
                         "analog (visdom dropped, jsonl added)")
     t.add_argument("--uid", type=str, default="")
+    t.add_argument("--num-synth-samples", type=int, default=0,
+                   help="dataset size for --task synth (test = 1/10th); "
+                        "0 = default 20000")
     # Model (main.py:56-70)
     m = p.add_argument_group("model")
     m.add_argument("--arch", type=str, default="resnet50")
@@ -187,7 +190,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             image_size_override=args.image_size_override,
             log_dir=args.log_dir, uid=args.uid,
             grapher=args.grapher,
-            data_backend=args.data_backend),
+            data_backend=args.data_backend,
+            num_synth_samples=args.num_synth_samples),
         model=ModelConfig(
             arch=args.arch,
             representation_size=(args.representation_size

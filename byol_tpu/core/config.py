@@ -41,6 +41,10 @@ class TaskConfig:
     # jitted two-view augmentation, data/device_augment.py).  The latter two
     # are the DALI equivalents (reference main.py:356-382).
     data_backend: str = "tf"
+    # Dataset size for the offline-learnable 'synth' task (test split is
+    # 1/10th); committed evidence runs use this to stay reproducible from
+    # the CLI alone.  0 = loader default (20k).
+    num_synth_samples: int = 0
 
 
 @_frozen

@@ -202,7 +202,7 @@ def _device_pipeline(images: np.ndarray, labels: np.ndarray, *,
 
 
 def get_loader(cfg: Config, *, num_fake_samples: int = 512,
-               num_synth_samples: int = 20_000,
+               num_synth_samples: Optional[int] = None,
                shard_eval: bool = False) -> LoaderBundle:
     """Dispatch on ``cfg.task.task``; see module docstring for the contract.
 
@@ -211,6 +211,8 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
     main.py:38-39).
     """
     task = cfg.task.task
+    if num_synth_samples is None:   # explicit kwarg wins over the config
+        num_synth_samples = cfg.task.num_synth_samples or 20_000
     # Reference task-name aliases (main.py:38-39; README.md:93): the DALI
     # variant maps to the native C++ backend for array tasks and to the
     # fused-decode tf.data path for image trees — ONE canonical augmentation
