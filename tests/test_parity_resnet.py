@@ -1,0 +1,142 @@
+"""ResNet-18 backbone forward parity vs an independent torch build.
+
+torchvision is not installed here, so the torch side is built IN THIS TEST
+from the torchvision ResNet architecture definition (7x7/2 stem + BN +
+ReLU + 3x3/2 maxpool, post-activation BasicBlocks with 1x1 downsample on
+shape change, global average pool — the structure the reference consumes
+via ``models.__dict__[args.arch]``, main.py:190-193).  Its randomly
+initialized weights are mapped onto :class:`byol_tpu.models.resnet.ResNet`
+and the two must produce the same features in train mode (BN on batch
+statistics), pinning conv padding, stride, BN, pooling, and residual-path
+conventions across frameworks where the model's FLOPs actually live.
+
+The flax model is built with ``zero_init_residual=False`` to match
+torchvision's default (the gate exists for exactly this parity,
+resnet.py).
+"""
+import numpy as np
+import torch
+import torch.nn as tnn
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from byol_tpu.models.resnet import make_resnet
+
+
+class TorchBasicBlock(tnn.Module):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(cout)
+        self.conv2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.down is None else self.down(x)
+        y = F.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return F.relu(y + idn)
+
+
+class TorchResNet18(tnn.Module):
+    def __init__(self):
+        super().__init__()
+        self.stem = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn = tnn.BatchNorm2d(64)
+        widths, blocks = [64, 128, 256, 512], [2, 2, 2, 2]
+        layers, cin = [], 64
+        for i, (w, n) in enumerate(zip(widths, blocks)):
+            for j in range(n):
+                stride = 2 if (i > 0 and j == 0) else 1
+                layers.append(TorchBasicBlock(cin, w, stride))
+                cin = w
+        self.blocks = tnn.ModuleList(layers)
+
+    def forward(self, x):
+        x = F.relu(self.bn(self.stem(x)))
+        x = F.max_pool2d(x, 3, 2, 1)
+        for b in self.blocks:
+            x = b(x)
+        return x.mean(dim=(2, 3))
+
+
+def _wj(t):
+    return jnp.asarray(t.detach().numpy())
+
+
+def _conv_k(conv):                      # OIHW -> HWIO
+    return _wj(conv.weight).transpose(2, 3, 1, 0)
+
+
+def _bn_vars(bn):
+    return ({"scale": _wj(bn.weight), "bias": _wj(bn.bias)},
+            {"mean": _wj(bn.running_mean), "var": _wj(bn.running_var)})
+
+
+def _map_params(tm: TorchResNet18):
+    params = {"stem_conv": {"kernel": _conv_k(tm.stem)}}
+    stats = {}
+    params["stem_bn"], stats["stem_bn"] = _bn_vars(tm.bn)
+    idx = 0
+    for i, n in enumerate([2, 2, 2, 2]):
+        for j in range(n):
+            b = tm.blocks[idx]
+            idx += 1
+            name = f"stage{i + 1}_block{j + 1}"
+            p = {"conv1": {"kernel": _conv_k(b.conv1)},
+                 "conv2": {"kernel": _conv_k(b.conv2)}}
+            s = {}
+            p["bn1"], s["bn1"] = _bn_vars(b.bn1)
+            p["bn2"], s["bn2"] = _bn_vars(b.bn2)
+            if b.down is not None:
+                p["downsample_conv"] = {"kernel": _conv_k(b.down[0])}
+                p["downsample_bn"], s["downsample_bn"] = _bn_vars(b.down[1])
+            params[name] = p
+            stats[name] = s
+    return params, stats
+
+
+class TestResNetForwardParity:
+    def test_train_mode_features_match_torch(self):
+        torch.manual_seed(0)
+        tm = TorchResNet18()
+        tm.train()
+        x = np.random.RandomState(0).rand(4, 3, 64, 64).astype(np.float32)
+        with torch.no_grad():
+            want = tm(torch.from_numpy(x)).numpy()
+
+        fm = make_resnet("resnet18", zero_init_residual=False)
+        params, stats = _map_params(tm)
+        got = fm.apply({"params": params, "batch_stats": stats},
+                       jnp.asarray(x.transpose(0, 2, 3, 1)),   # NCHW->NHWC
+                       train=True, mutable=["batch_stats"])[0]
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_eval_mode_uses_running_stats_like_torch(self):
+        torch.manual_seed(1)
+        tm = TorchResNet18()
+        # non-trivial running stats so eval mode actually exercises them
+        with torch.no_grad():
+            for m in tm.modules():
+                if isinstance(m, tnn.BatchNorm2d):
+                    m.running_mean.uniform_(-0.5, 0.5)
+                    m.running_var.uniform_(0.5, 1.5)
+        tm.eval()
+        x = np.random.RandomState(1).rand(2, 3, 32, 32).astype(np.float32)
+        with torch.no_grad():
+            want = tm(torch.from_numpy(x)).numpy()
+
+        fm = make_resnet("resnet18", zero_init_residual=False)
+        params, stats = _map_params(tm)
+        got = fm.apply({"params": params, "batch_stats": stats},
+                       jnp.asarray(x.transpose(0, 2, 3, 1)),
+                       train=False, mutable=False)
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-4)
