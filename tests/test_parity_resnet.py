@@ -1,9 +1,11 @@
-"""ResNet-18 backbone forward parity vs an independent torch build.
+"""ResNet backbone forward parity vs independent torch builds.
+
+Covers ResNet-18 (BasicBlock) and ResNet-50 (Bottleneck).
 
 torchvision is not installed here, so the torch side is built IN THIS TEST
 from the torchvision ResNet architecture definition (7x7/2 stem + BN +
 ReLU + 3x3/2 maxpool, post-activation BasicBlocks with 1x1 downsample on
-shape change, global average pool — the structure the reference consumes
+shape change, same for Bottlenecks, global average pool — the structure the reference consumes
 via ``models.__dict__[args.arch]``, main.py:190-193).  Its randomly
 initialized weights are mapped onto :class:`byol_tpu.models.resnet.ResNet`
 and the two must produce the same features in train mode (BN on batch
@@ -15,6 +17,7 @@ torchvision's default (the gate exists for exactly this parity,
 resnet.py).
 """
 import numpy as np
+import pytest
 import torch
 import torch.nn as tnn
 import torch.nn.functional as F
@@ -44,18 +47,44 @@ class TorchBasicBlock(tnn.Module):
         return F.relu(y + idn)
 
 
-class TorchResNet18(tnn.Module):
-    def __init__(self):
+class TorchBottleneck(tnn.Module):
+    def __init__(self, cin, width, stride):
         super().__init__()
+        cout = width * 4
+        self.conv1 = tnn.Conv2d(cin, width, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.conv2 = tnn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(width)
+        self.conv3 = tnn.Conv2d(width, cout, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.down is None else self.down(x)
+        y = F.relu(self.bn1(self.conv1(x)))
+        y = F.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return F.relu(y + idn)
+
+
+class TorchResNet(tnn.Module):
+    def __init__(self, block_cls, stage_sizes):
+        super().__init__()
+        self.stage_sizes = stage_sizes
         self.stem = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
         self.bn = tnn.BatchNorm2d(64)
-        widths, blocks = [64, 128, 256, 512], [2, 2, 2, 2]
+        widths = [64, 128, 256, 512]
+        expansion = 4 if block_cls is TorchBottleneck else 1
         layers, cin = [], 64
-        for i, (w, n) in enumerate(zip(widths, blocks)):
+        for i, (w, n) in enumerate(zip(widths, stage_sizes)):
             for j in range(n):
                 stride = 2 if (i > 0 and j == 0) else 1
-                layers.append(TorchBasicBlock(cin, w, stride))
-                cin = w
+                layers.append(block_cls(cin, w, stride))
+                cin = w * expansion
         self.blocks = tnn.ModuleList(layers)
 
     def forward(self, x):
@@ -79,33 +108,59 @@ def _bn_vars(bn):
             {"mean": _wj(bn.running_mean), "var": _wj(bn.running_var)})
 
 
-def _map_params(tm: TorchResNet18):
+
+def _map_block(b):
+    """One torch block -> (params, batch_stats) subtrees (shared by the
+    full-net mapper and the single-block tests so the two can't drift)."""
+    p, s = {}, {}
+    for k in ("conv1", "conv2", "conv3"):
+        if hasattr(b, k):
+            p[k] = {"kernel": _conv_k(getattr(b, k))}
+    for k in ("bn1", "bn2", "bn3"):
+        if hasattr(b, k):
+            p[k], s[k] = _bn_vars(getattr(b, k))
+    if b.down is not None:
+        p["downsample_conv"] = {"kernel": _conv_k(b.down[0])}
+        p["downsample_bn"], s["downsample_bn"] = _bn_vars(b.down[1])
+    return p, s
+
+
+def _map_params(tm: TorchResNet):
     params = {"stem_conv": {"kernel": _conv_k(tm.stem)}}
     stats = {}
     params["stem_bn"], stats["stem_bn"] = _bn_vars(tm.bn)
     idx = 0
-    for i, n in enumerate([2, 2, 2, 2]):
+    for i, n in enumerate(tm.stage_sizes):
         for j in range(n):
             b = tm.blocks[idx]
             idx += 1
             name = f"stage{i + 1}_block{j + 1}"
-            p = {"conv1": {"kernel": _conv_k(b.conv1)},
-                 "conv2": {"kernel": _conv_k(b.conv2)}}
-            s = {}
-            p["bn1"], s["bn1"] = _bn_vars(b.bn1)
-            p["bn2"], s["bn2"] = _bn_vars(b.bn2)
-            if b.down is not None:
-                p["downsample_conv"] = {"kernel": _conv_k(b.down[0])}
-                p["downsample_bn"], s["downsample_bn"] = _bn_vars(b.down[1])
-            params[name] = p
-            stats[name] = s
+            params[name], stats[name] = _map_block(b)
     return params, stats
 
 
+def _randomize_running_stats(tm):
+    # non-trivial running stats so eval mode actually exercises them
+    with torch.no_grad():
+        for m in tm.modules():
+            if isinstance(m, tnn.BatchNorm2d):
+                m.running_mean.uniform_(-0.5, 0.5)
+                m.running_var.uniform_(0.5, 1.5)
+
+
 class TestResNetForwardParity:
-    def test_train_mode_features_match_torch(self):
+    def test_resnet18_train_mode_features_match_torch(self):
+        # Train mode (BN on batch statistics) is only numerically comparable
+        # while the late stages keep enough spatial extent: at small images
+        # the last stage normalizes over ~batch-many values per channel and
+        # train-mode BN amplifies fp32 noise unboundedly when two values
+        # nearly coincide (verified: identical inputs through the same
+        # stride-2 block match to 1e-14 in fp64 at every spatial size, so
+        # the divergence is conditioning, not conventions).  rn18@64px is
+        # well-conditioned; rn50 train-mode parity is covered by the exact
+        # single-block tests + the eval-mode full net below.
         torch.manual_seed(0)
-        tm = TorchResNet18()
+        tm = TorchResNet(TorchBasicBlock, [2, 2, 2, 2])
         tm.train()
         x = np.random.RandomState(0).rand(4, 3, 64, 64).astype(np.float32)
         with torch.no_grad():
@@ -119,24 +174,58 @@ class TestResNetForwardParity:
         np.testing.assert_allclose(np.asarray(got), want,
                                    rtol=1e-4, atol=1e-4)
 
-    def test_eval_mode_uses_running_stats_like_torch(self):
+    @pytest.mark.parametrize("arch,block_cls,stages", [
+        ("resnet18", TorchBasicBlock, [2, 2, 2, 2]),
+        ("resnet50", TorchBottleneck, [3, 4, 6, 3]),
+    ])
+    def test_eval_mode_uses_running_stats_like_torch(self, arch, block_cls,
+                                                     stages):
         torch.manual_seed(1)
-        tm = TorchResNet18()
-        # non-trivial running stats so eval mode actually exercises them
-        with torch.no_grad():
-            for m in tm.modules():
-                if isinstance(m, tnn.BatchNorm2d):
-                    m.running_mean.uniform_(-0.5, 0.5)
-                    m.running_var.uniform_(0.5, 1.5)
+        tm = TorchResNet(block_cls, stages)
+        _randomize_running_stats(tm)
         tm.eval()
         x = np.random.RandomState(1).rand(2, 3, 32, 32).astype(np.float32)
         with torch.no_grad():
             want = tm(torch.from_numpy(x)).numpy()
 
-        fm = make_resnet("resnet18", zero_init_residual=False)
+        fm = make_resnet(arch, zero_init_residual=False)
         params, stats = _map_params(tm)
         got = fm.apply({"params": params, "batch_stats": stats},
                        jnp.asarray(x.transpose(0, 2, 3, 1)),
                        train=False, mutable=False)
         np.testing.assert_allclose(np.asarray(got), want,
                                    rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    @pytest.mark.parametrize("block", ["basic", "bottleneck"])
+    def test_single_block_train_mode_exact(self, block, stride):
+        """Each block type in isolation, train-mode BN, identical inputs —
+        must match torch to fp32 tightness at every tested size (this is
+        the convention check the full-net train comparison can't give for
+        deep stacks, see test_resnet18_train_mode_features_match_torch)."""
+        import functools
+        import flax.linen as nn
+        from byol_tpu.models.resnet import BasicBlock, Bottleneck
+        conv = functools.partial(nn.Conv, use_bias=False)
+        norm = functools.partial(nn.BatchNorm, use_running_average=False,
+                                 momentum=0.9, epsilon=1e-5)
+        torch.manual_seed(0)
+        if block == "basic":
+            tb = TorchBasicBlock(16, 16 if stride == 1 else 32, stride)
+            fb = BasicBlock(filters=tb.conv1.out_channels,
+                            strides=(stride, stride), conv=conv, norm=norm,
+                            zero_init_last_bn=False)
+        else:
+            tb = TorchBottleneck(16, 8, stride)
+            fb = Bottleneck(filters=8, strides=(stride, stride), conv=conv,
+                            norm=norm, zero_init_last_bn=False)
+        tb.train()
+        x = np.random.RandomState(0).rand(2, 16, 8, 8).astype(np.float32)
+        with torch.no_grad():
+            want = tb(torch.from_numpy(x)).numpy().transpose(0, 2, 3, 1)
+        p, s = _map_block(tb)
+        got, _ = fb.apply({"params": p, "batch_stats": s},
+                          jnp.asarray(x.transpose(0, 2, 3, 1)),
+                          mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-5, atol=1e-5)
