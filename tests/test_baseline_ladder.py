@@ -1,0 +1,59 @@
+"""Executable BASELINE.json config ladder.
+
+BASELINE.json names five headline configurations (CIFAR smoke through the
+ViT-B/16 encoder swap).  Each must BUILD and take one finite training step
+through the public ``setup_training`` path — at tiny shapes, so this runs
+on the CPU mesh; the full-scale versions only change sizes, not code
+paths.  This is the SURVEY.md §7 stage-10 "config ladder" made an
+executable regression rather than prose.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
+                                  OptimConfig, TaskConfig, resolve)
+from byol_tpu.parallel.mesh import MeshSpec, build_mesh, shard_batch_to_mesh
+from byol_tpu.training.build import setup_training
+
+# (label, arch, image, GLOBAL batch (TaskConfig.batch_size — split across
+#  the data axis by resolve()), data-axis size, half, extra model kw)
+LADDER = [
+    ("c1_cifar_smoke", "resnet18", 16, 16, 1, False, {}),
+    ("c2_in100_syncbn_lars", "resnet50", 32, 8, 1, False, {}),
+    ("c3_in1k_pod_dp8", "resnet50", 32, 16, 8, False, {"fuse_views": True}),
+    ("c4_rn200w2_bf16", "resnet200w2", 16, 4, 1, True, {"fuse_views": True}),
+    ("c5_vit_b16", "vit_b16", 32, 4, 1, False, {"pooling": "gap"}),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("label,arch,image,batch,dp,half,extra",
+                         LADDER, ids=[r[0] for r in LADDER])
+def test_baseline_config_builds_and_steps(label, arch, image, batch, dp,
+                                          half, extra):
+    if dp > jax.device_count():
+        pytest.skip(f"needs {dp} devices")
+    mesh = build_mesh(MeshSpec(data=dp),
+                      jax.devices()[:dp])
+    cfg = Config(
+        task=TaskConfig(task="fake", batch_size=batch, epochs=2,
+                        image_size_override=image),
+        model=ModelConfig(arch=arch, head_latent_size=64,
+                          projection_size=32, **extra),
+        optim=OptimConfig(lr=0.2, warmup=1, optimizer="lars_momentum"),
+        device=DeviceConfig(num_replicas=dp, half=half, seed=0),
+    )
+    rcfg = resolve(cfg, num_train_samples=4 * batch, num_test_samples=batch,
+                   output_size=10, input_shape=(image, image, 3))
+    net, state, train_step, eval_step, _ = setup_training(
+        rcfg, mesh, jax.random.PRNGKey(0))
+
+    from tests.test_train_step import make_batch
+    data = shard_batch_to_mesh(make_batch(rcfg), mesh)
+    state, metrics = train_step(state, data)
+    loss = float(metrics["loss_mean"])
+    assert np.isfinite(loss), f"{label}: non-finite loss {loss}"
+    eval_metrics = eval_step(state, data)
+    assert np.isfinite(float(eval_metrics["loss_mean"]))
