@@ -80,6 +80,47 @@ class TestResNet:
             m.init(jax.random.PRNGKey(0), jnp.zeros((1, 33, 33, 3)),
                    train=False)
 
+    @pytest.mark.slow
+    def test_space_to_depth_stem_through_setup_training(self):
+        # The stem knob is inert below the CIFAR-stem threshold (image <=
+        # 64), so this must run at a REAL imagenet-stem size — a 16px smoke
+        # would silently test the wrong path.  With identical seeds the
+        # two stems share init (same param tree), so one train step must
+        # produce matching losses.
+        import numpy as np
+        from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
+                                          TaskConfig, resolve)
+        from byol_tpu.parallel.mesh import (MeshSpec, build_mesh,
+                                            shard_batch_to_mesh)
+        from byol_tpu.training.build import setup_training
+
+        losses = {}
+        for stem in ("conv", "space_to_depth"):
+            mesh = build_mesh(MeshSpec(data=1), jax.devices()[:1])
+            cfg = Config(
+                task=TaskConfig(task="fake", batch_size=4, epochs=2,
+                                image_size_override=96),
+                model=ModelConfig(arch="resnet18", head_latent_size=32,
+                                  projection_size=16, stem=stem),
+                device=DeviceConfig(num_replicas=1, half=False, seed=0),
+            )
+            rcfg = resolve(cfg, num_train_samples=16, num_test_samples=4,
+                           output_size=10, input_shape=(96, 96, 3))
+            net, state, train_step, _, _ = setup_training(
+                rcfg, mesh, jax.random.PRNGKey(0))
+            k = state.params["backbone"]["stem_conv"]["kernel"]
+            assert k.shape == (7, 7, 3, 64)    # reparametrized, not re-shaped
+            rng = np.random.RandomState(0)
+            batch = shard_batch_to_mesh({
+                "view1": rng.rand(4, 96, 96, 3).astype(np.float32),
+                "view2": rng.rand(4, 96, 96, 3).astype(np.float32),
+                "label": rng.randint(0, 10, size=(4,)).astype(np.int32),
+            }, mesh)
+            _, metrics = train_step(state, batch)
+            losses[stem] = float(metrics["loss_mean"])
+        assert losses["conv"] == pytest.approx(losses["space_to_depth"],
+                                               rel=1e-4)
+
 
 class TestHeads:
     def test_mlp_head_shapes(self):
