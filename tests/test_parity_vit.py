@@ -1,0 +1,102 @@
+"""ViT attention / encoder-block parity vs torch.
+
+The reference has no attention anywhere (ResNet path, main.py:190-193) and
+torchvision is absent, so there is no reference ViT to be faithful to —
+but the multi-head attention and transformer-block CONVENTIONS (packed QKV
+projection layout, per-head scaling, softmax axis, pre-LN residual wiring)
+can still be pinned against torch's ``nn.MultiheadAttention``, the
+ecosystem-standard implementation.  This closes the last model family the
+torch parity harness (PARITY.md §4) did not cover.
+
+Alignment notes: torch's in_proj packs rows [Wq; Wk; Wv] while the flax
+``qkv`` Dense packs output columns [q | k | v] — mapped by transposing and
+concatenating along axis 1.  The MLP comparison uses
+``tnn.GELU(approximate='tanh')`` to match ``jax.nn.gelu``'s default tanh
+approximation, and the torch LayerNorms are built with ``eps=1e-6`` to
+match flax's default (torch's is 1e-5 — a real convention delta this test
+would otherwise paper over; measured, it shifts block outputs by ~1e-4).
+"""
+import numpy as np
+import torch
+import torch.nn as tnn
+
+import jax.numpy as jnp
+
+from byol_tpu.models.vit import EncoderBlock, SelfAttention
+
+B, S, D, H = 2, 10, 32, 4
+
+
+def _wj(t):
+    return jnp.asarray(t.detach().numpy())
+
+
+def _map_attention(mha: tnn.MultiheadAttention):
+    wq, wk, wv = mha.in_proj_weight.chunk(3)     # each (D, D)
+    bq, bk, bv = mha.in_proj_bias.chunk(3)
+    return {
+        "qkv": {"kernel": jnp.concatenate(
+                    [_wj(wq).T, _wj(wk).T, _wj(wv).T], axis=1),
+                "bias": jnp.concatenate([_wj(bq), _wj(bk), _wj(bv)])},
+        "proj": {"kernel": _wj(mha.out_proj.weight).T,
+                 "bias": _wj(mha.out_proj.bias)},
+    }
+
+
+class TestAttentionParity:
+    def test_self_attention_matches_torch_mha(self):
+        torch.manual_seed(0)
+        mha = tnn.MultiheadAttention(D, H, batch_first=True)
+        x = np.random.RandomState(0).rand(B, S, D).astype(np.float32)
+        with torch.no_grad():
+            want, _ = mha(torch.from_numpy(x), torch.from_numpy(x),
+                          torch.from_numpy(x), need_weights=False)
+        att = SelfAttention(num_heads=H)
+        got = att.apply({"params": _map_attention(mha)}, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TorchPreLNBlock(tnn.Module):
+    """Pre-LN transformer block wired exactly like models/vit.EncoderBlock."""
+
+    def __init__(self, d, h, mlp_ratio=4):
+        super().__init__()
+        self.ln1 = tnn.LayerNorm(d, eps=1e-6)
+        self.attn = tnn.MultiheadAttention(d, h, batch_first=True)
+        self.ln2 = tnn.LayerNorm(d, eps=1e-6)
+        self.fc1 = tnn.Linear(d, mlp_ratio * d)
+        self.fc2 = tnn.Linear(mlp_ratio * d, d)
+        self.gelu = tnn.GELU(approximate="tanh")   # = jax.nn.gelu default
+
+    def forward(self, x):
+        y = self.ln1(x)
+        x = x + self.attn(y, y, y, need_weights=False)[0]
+        y = self.ln2(x)
+        return x + self.fc2(self.gelu(self.fc1(y)))
+
+
+class TestEncoderBlockParity:
+    def test_pre_ln_block_matches_torch(self):
+        torch.manual_seed(1)
+        tb = TorchPreLNBlock(D, H)
+        x = np.random.RandomState(1).rand(B, S, D).astype(np.float32)
+        with torch.no_grad():
+            want = tb(torch.from_numpy(x)).numpy()
+
+        def ln(m):
+            return {"scale": _wj(m.weight), "bias": _wj(m.bias)}
+
+        params = {
+            "ln1": ln(tb.ln1),
+            "attn": _map_attention(tb.attn),
+            "ln2": ln(tb.ln2),
+            "mlp": {"fc1": {"kernel": _wj(tb.fc1.weight).T,
+                            "bias": _wj(tb.fc1.bias)},
+                    "fc2": {"kernel": _wj(tb.fc2.weight).T,
+                            "bias": _wj(tb.fc2.bias)}},
+        }
+        block = EncoderBlock(num_heads=H)
+        got = block.apply({"params": params}, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-5, atol=1e-5)
