@@ -133,16 +133,21 @@ def gaussian_blur(image: tf.Tensor, kernel_size: int, seed,
     """Depthwise separable gaussian blur; kernel_size = int(.1 * image_size)
     per the reference's GaussianBlur(kernel_size, p=.5) (main.py:384,396)."""
     k = max(int(kernel_size) | 1, 3)  # odd, >= 3
+    r = k // 2
     sigma = _uniform(seed, (), *sigma_range)
-    x = tf.range(-(k // 2), k // 2 + 1, dtype=tf.float32)
+    x = tf.range(-r, r + 1, dtype=tf.float32)
     g = tf.exp(-(x ** 2) / (2.0 * sigma ** 2))
     g = g / tf.reduce_sum(g)
     ch = image.shape[-1] or 3
     kx = tf.tile(tf.reshape(g, (1, k, 1, 1)), [1, 1, ch, 1])
     ky = tf.tile(tf.reshape(g, (k, 1, 1, 1)), [1, 1, ch, 1])
-    img = image[tf.newaxis]
-    img = tf.nn.depthwise_conv2d(img, kx, [1, 1, 1, 1], "SAME")
-    img = tf.nn.depthwise_conv2d(img, ky, [1, 1, 1, 1], "SAME")
+    # reflect-101 borders (the cv2 GaussianBlur convention, matched by the
+    # native C++ backend): zero padding would dim border pixels because the
+    # kernel weights falling outside the image contribute nothing.
+    img = tf.pad(image[tf.newaxis], [[0, 0], [r, r], [r, r], [0, 0]],
+                 mode="REFLECT")
+    img = tf.nn.depthwise_conv2d(img, kx, [1, 1, 1, 1], "VALID")
+    img = tf.nn.depthwise_conv2d(img, ky, [1, 1, 1, 1], "VALID")
     return img[0]
 
 

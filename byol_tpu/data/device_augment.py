@@ -102,15 +102,19 @@ def gaussian_blur(key, image: jnp.ndarray, kernel_size: int,
     g = jnp.exp(-(x ** 2) / (2.0 * sigma ** 2))
     g = g / jnp.sum(g)
     ch = image.shape[-1]
-    img = image[None]                                    # NHWC
+    r = k // 2
+    # reflect-101 borders — keeps all three blur backends (tf host, C++
+    # host, on-device) border-consistent; zero padding would dim border
+    # pixels (see data/augment.py:gaussian_blur).
+    img = jnp.pad(image, ((r, r), (r, r), (0, 0)), mode="reflect")[None]
     kx = jnp.tile(g.reshape(1, k, 1, 1), (1, 1, 1, ch))  # HWIO, grouped
     ky = jnp.tile(g.reshape(k, 1, 1, 1), (1, 1, 1, ch))
     dn = jax.lax.conv_dimension_numbers(img.shape, kx.shape,
                                         ("NHWC", "HWIO", "NHWC"))
-    img = jax.lax.conv_general_dilated(img, kx, (1, 1), "SAME",
+    img = jax.lax.conv_general_dilated(img, kx, (1, 1), "VALID",
                                        dimension_numbers=dn,
                                        feature_group_count=ch)
-    img = jax.lax.conv_general_dilated(img, ky, (1, 1), "SAME",
+    img = jax.lax.conv_general_dilated(img, ky, (1, 1), "VALID",
                                        dimension_numbers=dn,
                                        feature_group_count=ch)
     return img[0]

@@ -221,12 +221,20 @@ void augment_one(const uint8_t* src, int h, int w, float* dst, int size,
     }
     for (int i = 0; i < k; ++i) g[i] /= sum;
     std::vector<float> tmp(n * 3);
+    // reflect-101 border indexing (cv2 GaussianBlur convention; keeps the
+    // two DALI-analog backends bit-consistent with data/augment.py's
+    // REFLECT-padded depthwise conv)
+    auto reflect101 = [](int v, int n) {
+      if (v < 0) v = -v;
+      if (v >= n) v = 2 * n - 2 - v;
+      return v;
+    };
     // horizontal
     for (int y = 0; y < size; ++y) {
       for (int x = 0; x < size; ++x) {
         float acc[3] = {0, 0, 0};
         for (int t = -r; t <= r; ++t) {
-          int xx = std::min(std::max(x + t, 0), size - 1);
+          int xx = reflect101(x + t, size);
           const float* px = dst + (y * size + xx) * 3;
           for (int c = 0; c < 3; ++c) acc[c] += g[t + r] * px[c];
         }
@@ -238,7 +246,7 @@ void augment_one(const uint8_t* src, int h, int w, float* dst, int size,
       for (int x = 0; x < size; ++x) {
         float acc[3] = {0, 0, 0};
         for (int t = -r; t <= r; ++t) {
-          int yy = std::min(std::max(y + t, 0), size - 1);
+          int yy = reflect101(y + t, size);
           const float* px = tmp.data() + (yy * size + x) * 3;
           for (int c = 0; c < 3; ++c) acc[c] += g[t + r] * px[c];
         }

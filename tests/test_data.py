@@ -305,3 +305,50 @@ class TestPaperAugSpec:
         assert v1.shape == (8, 16, 16, 3)
         assert v1.min() >= 0.0 and v1.max() <= 1.0
         assert not np.allclose(v1, np.asarray(b["view2"]))
+
+
+class TestGaussianBlurOracle:
+    def test_blur_matches_torch_reflect_conv(self):
+        """Pin the blur math (kernel construction, separable application,
+        reflect-101 borders — the cv2 convention shared with the native C++
+        backend) against a torch depthwise-conv oracle at a fixed sigma."""
+        import tensorflow as tf
+        import torch
+        import torch.nn.functional as F
+        from byol_tpu.data.augment import gaussian_blur
+
+        sigma, k = 1.3, 5
+        img = np.random.RandomState(0).rand(12, 12, 3).astype(np.float32)
+        got = gaussian_blur(tf.constant(img), k, seed=(1, 2),
+                            sigma_range=(sigma, sigma)).numpy()
+
+        x = np.arange(k) - k // 2
+        g = np.exp(-(x ** 2) / (2.0 * sigma ** 2)).astype(np.float32)
+        g /= g.sum()
+        t = torch.from_numpy(img.transpose(2, 0, 1))[None]       # (1,3,H,W)
+        t = F.pad(t, (k // 2,) * 4, mode="reflect")
+        kx = torch.from_numpy(g).view(1, 1, 1, k).repeat(3, 1, 1, 1)
+        ky = torch.from_numpy(g).view(1, 1, k, 1).repeat(3, 1, 1, 1)
+        t = F.conv2d(t, kx, groups=3)
+        t = F.conv2d(t, ky, groups=3)
+        want = t[0].numpy().transpose(1, 2, 0)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_blur_preserves_constant_image_at_borders(self):
+        """Zero padding would dim border pixels of a constant image; the
+        reflect-padded blur must return it unchanged everywhere."""
+        import tensorflow as tf
+        from byol_tpu.data.augment import gaussian_blur
+        img = np.full((10, 10, 3), 0.7, np.float32)
+        out = gaussian_blur(tf.constant(img), 5, seed=(3, 4)).numpy()
+        np.testing.assert_allclose(out, img, rtol=1e-5, atol=1e-6)
+
+    def test_device_blur_preserves_constant_image_at_borders(self):
+        """Same border contract for the on-device (JAX) blur backend."""
+        import jax
+        import jax.numpy as jnp
+        from byol_tpu.data import device_augment
+        img = jnp.full((10, 10, 3), 0.7, jnp.float32)
+        out = device_augment.gaussian_blur(jax.random.PRNGKey(0), img, 5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(img),
+                                   rtol=1e-5, atol=1e-6)
