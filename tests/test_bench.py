@@ -142,6 +142,135 @@ class TestStaleFallback:
             bench.main()
 
 
+class TestSweepResume:
+    """A sweep re-run after a mid-sweep tunnel drop must converge: reuse
+    measured rows, never re-attempt the known compile-OOM (un-rematted
+    bs1024, whose compile attempt once crashed the remote-compile service),
+    and order the risky rematted-1024 rows last."""
+
+    _PRIOR = {
+        "device_kind": "TPU v5 lite",
+        "results": [
+            {"config": "sweep_bs512_remat0_fuse1", "batch_per_chip": 512,
+             "fit": True, "remat": False, "fuse_views": True,
+             "images_per_sec_per_chip": 709.4, "mfu": 0.235},
+            {"config": "sweep_bs384_remat0_fuse1", "batch_per_chip": 384,
+             "fit": False},
+        ],
+    }
+
+    @staticmethod
+    def _fake_tpu(bench, monkeypatch, kind="TPU v5 lite"):
+        import types
+        monkeypatch.setattr(
+            bench.jax, "devices",
+            lambda: [types.SimpleNamespace(device_kind=kind)])
+
+    def test_prior_rows_scanned_from_live_and_prev(self, bench, monkeypatch):
+        self._fake_tpu(bench, monkeypatch)
+        with open("bench_partial.json.prev", "w") as f:
+            json.dump(self._PRIOR, f)
+        with open("bench_partial.json", "w") as f:
+            json.dump({"device_kind": "TPU v5 lite", "results": [
+                {"config": "tpu_first", "fit": True},            # not sweep_*
+                {"config": "sweep_bs256_remat1_fuse1", "fit": True,
+                 "batch_per_chip": 256, "remat": True, "fuse_views": True,
+                 "images_per_sec_per_chip": 800.0, "mfu": 0.27}]}, f)
+        prior = bench._sweep_prior_rows()
+        assert set(prior) == {"sweep_bs512_remat0_fuse1",
+                              "sweep_bs384_remat0_fuse1",
+                              "sweep_bs256_remat1_fuse1"}
+
+    def test_other_device_kind_rows_are_not_reused(self, bench, monkeypatch):
+        # rows captured on a different chip generation (or the cpu
+        # fallback) are incomparable — never carried into this run
+        self._fake_tpu(bench, monkeypatch, kind="TPU v4")
+        for kind in ("cpu", "TPU v5 lite"):
+            with open("bench_partial.json", "w") as f:
+                json.dump(dict(self._PRIOR, device_kind=kind), f)
+            assert bench._sweep_prior_rows() == {}
+
+    def test_resume_of_a_resumed_sweep(self, bench, monkeypatch):
+        # a thrice-interrupted sweep reloads rows that were themselves
+        # recorded by a resume (they carry reused=True) — must not crash
+        self._fake_tpu(bench, monkeypatch)
+        prior = {"device_kind": "TPU v5 lite", "results": [
+            {"config": "sweep_bs512_remat0_fuse1", "batch_per_chip": 512,
+             "fit": True, "remat": False, "fuse_views": True, "reused": True,
+             "images_per_sec_per_chip": 709.4, "mfu": 0.235}]}
+        with open("bench_partial.json", "w") as f:
+            json.dump(prior, f)
+        monkeypatch.setattr(bench, "_throughput",
+                            lambda bs, *a, **k: 100.0)
+        monkeypatch.setattr(bench.jax, "default_backend", lambda: "tpu")
+        bench._sweep("resnet50", 224, [1024, 512, 256], lambda v: 0.1)
+        rows = json.load(open("bench_sweep.json"))
+        assert sum(r.get("images_per_sec_per_chip") == 709.4
+                   for r in rows) == 1
+
+    def test_sweep_table_rotated_not_clobbered(self, bench, monkeypatch):
+        # a partial re-run must never destroy a complete prior table: the
+        # existing bench_sweep.json moves to .prev before the new write
+        self._fake_tpu(bench, monkeypatch)
+        complete = [{"batch_per_chip": 512, "images_per_sec_per_chip": 1.0}]
+        with open("bench_sweep.json", "w") as f:
+            json.dump(complete, f)
+        monkeypatch.setattr(bench, "_throughput", lambda bs, *a, **k: 100.0)
+        monkeypatch.setattr(bench.jax, "default_backend", lambda: "tpu")
+        bench._sweep("resnet50", 224, [512, 256], lambda v: 0.1)
+        assert json.load(open("bench_sweep.json.prev")) == complete
+        assert json.load(open("bench_sweep.json"))[0][
+            "images_per_sec_per_chip"] == 100.0
+
+    def test_oom_rows_at_1024_stay_reused(self, bench, monkeypatch):
+        # the >=1024 compile-OOMs are the multi-minute failures (one crashed
+        # the remote-compile service) — their fit=False rows ARE reused
+        self._fake_tpu(bench, monkeypatch)
+        with open("bench_partial.json", "w") as f:
+            json.dump({"device_kind": "TPU v5 lite", "results": [
+                {"config": "sweep_bs1024_remat1_fuse1", "batch_per_chip": 1024,
+                 "fit": False}]}, f)
+        measured = []
+
+        def fake_throughput(bs, image_size, arch, **kw):
+            measured.append((bs, kw["remat"], kw["fuse_views"]))
+            return 100.0
+        monkeypatch.setattr(bench, "_throughput", fake_throughput)
+        monkeypatch.setattr(bench.jax, "default_backend", lambda: "tpu")
+        bench._sweep("resnet50", 224, [1024, 512, 256], lambda v: 0.1)
+        assert (1024, True, True) not in measured
+        assert (1024, True, False) in measured   # distinct config still runs
+
+    def test_grid_reuses_prior_and_never_reattempts_oom_1024(
+            self, bench, monkeypatch):
+        self._fake_tpu(bench, monkeypatch)
+        with open("bench_partial.json", "w") as f:
+            json.dump(self._PRIOR, f)
+        measured = []
+
+        def fake_throughput(bs, image_size, arch, **kw):
+            measured.append((bs, kw["remat"], kw["fuse_views"]))
+            return 100.0
+        monkeypatch.setattr(bench, "_throughput", fake_throughput)
+        monkeypatch.setattr(bench.jax, "default_backend", lambda: "tpu")
+        bench._sweep("resnet50", 224, [1024, 512, 256, 128, 64, 32],
+                     lambda v: 0.1)
+        # the measured (fit=True) row was not re-measured...
+        assert (512, False, True) not in measured
+        # ...but a sub-1024 fit=False row IS re-attempted: it may be a
+        # mislabeled transient, and its re-measure is cheap
+        assert (384, False, True) in measured
+        # un-rematted 1024 never attempted; rematted 1024 attempted LAST
+        assert all(remat for bs, remat, _ in measured if bs == 1024)
+        assert [m for m in measured if m[0] == 1024] == measured[-2:]
+        # no rung below 256 in the sweep grid
+        assert min(bs for bs, _, _ in measured) >= 256
+        rows = json.load(open("bench_sweep.json"))
+        reused = [r for r in rows
+                  if r.get("images_per_sec_per_chip") == 709.4]
+        assert len(reused) == 1      # measured row carried into the table
+
+
 class TestMFUAccounting:
     def test_flops_per_sample_uses_8_forward_equivalents(self, bench):
         # 2 online + 2 target fwds + backward(2x) = 8 fwd-images, 2 FLOPs/MAC
