@@ -224,6 +224,17 @@ def _config_failed(context: str, exc: BaseException) -> bool:
     return False
 
 
+def _oom_signature(exc_text: str) -> bool:
+    """Does a recorded failure look like a deterministic memory/compile
+    failure (safe to pin across runs), as opposed to a transient tunnel
+    error that deserves a re-attempt?  On this platform compile-OOM spells
+    itself ``tpu_compile_helper subprocess exit code 1`` with "Ran out of
+    memory" only lowercase deep in the dump (VERDICT r2)."""
+    low = exc_text.lower()
+    return ("resource_exhausted" in low or "out of memory" in low
+            or "ran out of memory" in low or "tpu_compile_helper" in low)
+
+
 _flushed_paths: set = set()
 
 
@@ -407,7 +418,8 @@ def main():
             except Exception as e:
                 if _config_failed(f"config={name} bs/chip={bs}", e):
                     break
-                _record(name, batch_per_chip=bs, fit=False)
+                _record(name, batch_per_chip=bs, fit=False,
+                        error=repr(e)[:300])
                 continue
             _record(name, batch_per_chip=bs, fit=True,
                     images_per_sec_per_chip=round(val, 2), mfu=mfu_of(val),
@@ -572,10 +584,14 @@ def _sweep(arch, image_size, candidates, mfu_of):
         name = f"sweep_bs{bs}_remat{int(remat)}_fuse{int(fuse)}"
         # Reuse rule: fit=True rows always; fit=False rows only at the
         # >=1024 rungs (the multi-minute compile-OOMs worth never
-        # repeating).  A smaller rung's fit=False may be a mislabeled
-        # transient (tunnel hiccup that recovered within the probe) — its
-        # re-measure is cheap, so resume must not pin it forever.
-        if name in prior and (prior[name].get("fit") or bs >= 1024):
+        # repeating) AND only when the recorded error carries a genuine
+        # OOM signature — a transient tunnel error that slipped past the
+        # liveness probe must not permanently mask a config that fits.
+        # Smaller rungs' fit=False rows always re-measure (cheap).
+        if name in prior and (
+                prior[name].get("fit")
+                or (bs >= 1024
+                    and _oom_signature(str(prior[name].get("error", ""))))):
             # strip 'reused' too: a thrice-interrupted sweep reloads rows
             # that were themselves recorded by a resume
             r = {k: v for k, v in prior[name].items()
@@ -597,7 +613,8 @@ def _sweep(arch, image_size, candidates, mfu_of):
         except Exception as e:
             if _config_failed(name, e):
                 break
-            _record(name, batch_per_chip=bs, fit=False)
+            _record(name, batch_per_chip=bs, fit=False,
+                    error=repr(e)[:300])
             continue
         row = {"batch_per_chip": bs, "remat": remat,
                "fuse_views": fuse,

@@ -67,17 +67,28 @@ def summarize(trace_path: str, top_n: int = 30):
             return False
         return not op_tids or (e["pid"], e.get("tid")) in op_tids
 
-    total = collections.Counter()
-    count = collections.Counter()
-    busy = 0.0
-    for e in events:
-        if e.get("ph") != "X" or not on_op_track(e):
-            continue
-        dur = float(e.get("dur", 0.0))   # microseconds
-        name = e.get("name", "?")
-        total[name] += dur
-        count[name] += 1
-        busy += dur
+    def aggregate(keep):
+        total = collections.Counter()
+        count = collections.Counter()
+        busy = 0.0
+        for e in events:
+            if e.get("ph") != "X" or not keep(e):
+                continue
+            dur = float(e.get("dur", 0.0))   # microseconds
+            name = e.get("name", "?")
+            total[name] += dur
+            count[name] += 1
+            busy += dur
+        return total, count, busy
+
+    total, count, busy = aggregate(on_op_track)
+    if not total:
+        # Unfamiliar track layout (e.g. a CPU-backend trace, where ops land
+        # on host threads): better an over-inclusive table than an empty one.
+        print("trace_top_ops: no events on recognized device-op tracks; "
+              "falling back to ALL complete events (region events may "
+              "double-count their children)", file=sys.stderr)
+        total, count, busy = aggregate(lambda e: True)
     rows = [(t, count[n], n) for n, t in total.most_common(top_n)]
     return rows, busy
 

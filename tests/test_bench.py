@@ -222,14 +222,13 @@ class TestSweepResume:
         assert json.load(open("bench_sweep.json"))[0][
             "images_per_sec_per_chip"] == 100.0
 
-    def test_oom_rows_at_1024_stay_reused(self, bench, monkeypatch):
-        # the >=1024 compile-OOMs are the multi-minute failures (one crashed
-        # the remote-compile service) — their fit=False rows ARE reused
+    def _measure_with_prior_1024_row(self, bench, monkeypatch, row_extra):
         self._fake_tpu(bench, monkeypatch)
         with open("bench_partial.json", "w") as f:
             json.dump({"device_kind": "TPU v5 lite", "results": [
-                {"config": "sweep_bs1024_remat1_fuse1", "batch_per_chip": 1024,
-                 "fit": False}]}, f)
+                dict({"config": "sweep_bs1024_remat1_fuse1",
+                      "batch_per_chip": 1024, "fit": False}, **row_extra)]},
+                      f)
         measured = []
 
         def fake_throughput(bs, image_size, arch, **kw):
@@ -238,8 +237,27 @@ class TestSweepResume:
         monkeypatch.setattr(bench, "_throughput", fake_throughput)
         monkeypatch.setattr(bench.jax, "default_backend", lambda: "tpu")
         bench._sweep("resnet50", 224, [1024, 512, 256], lambda v: 0.1)
+        return measured
+
+    def test_oom_rows_at_1024_stay_reused(self, bench, monkeypatch):
+        # the >=1024 compile-OOMs are the multi-minute failures (one crashed
+        # the remote-compile service) — fit=False rows whose recorded error
+        # carries a genuine OOM signature ARE reused
+        measured = self._measure_with_prior_1024_row(
+            bench, monkeypatch,
+            {"error": "JaxRuntimeError('INTERNAL: ... tpu_compile_helper "
+                      "subprocess exit code 1')"})
         assert (1024, True, True) not in measured
         assert (1024, True, False) in measured   # distinct config still runs
+
+    def test_transient_1024_failures_are_reattempted(self, bench,
+                                                     monkeypatch):
+        # a tunnel drop that slipped past the liveness probe must not
+        # permanently mask the one config where bs1024 might fit: without
+        # an OOM signature (or with no recorded error at all) re-attempt
+        measured = self._measure_with_prior_1024_row(
+            bench, monkeypatch, {"error": "UNAVAILABLE: Socket closed"})
+        assert (1024, True, True) in measured
 
     def test_grid_reuses_prior_and_never_reattempts_oom_1024(
             self, bench, monkeypatch):
