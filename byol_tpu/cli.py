@@ -34,8 +34,9 @@ def build_parser() -> argparse.ArgumentParser:
     t = p.add_argument_group("task")
     t.add_argument("--task", type=str, default="image_folder",
                    help="image_folder | cifar10 | cifar100 | mnist | "
-                        "fashion_mnist | fake | synth (procedural "
-                        "learnable dataset, works offline)")
+                        "fashion_mnist | digits (real images bundled with "
+                        "sklearn, works offline) | fake | synth "
+                        "(procedural learnable dataset, works offline)")
     t.add_argument("--batch-size", type=int, default=4096,
                    help="GLOBAL batch size")
     t.add_argument("--epochs", type=int, default=3000)

@@ -206,9 +206,9 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
                shard_eval: bool = False) -> LoaderBundle:
     """Dispatch on ``cfg.task.task``; see module docstring for the contract.
 
-    Tasks: 'fake', 'cifar10', 'cifar100', 'mnist', 'fashion_mnist',
-    'image_folder' (the reference's multi_augment_image_folder default,
-    main.py:38-39).
+    Tasks: 'fake', 'synth', 'digits', 'cifar10', 'cifar100', 'mnist',
+    'fashion_mnist', 'image_folder' (the reference's
+    multi_augment_image_folder default, main.py:38-39).
     """
     task = cfg.task.task
     if num_synth_samples is None:   # explicit kwarg wins over the config

@@ -1,4 +1,4 @@
-"""Array dataset readers: CIFAR-10/100, MNIST, FashionMNIST, fake data.
+"""Array dataset readers: CIFAR-10/100, MNIST, FashionMNIST, digits, fake.
 
 The reference delegates simple datasets (with ``--download``) to its
 ``datasets`` submodule (/root/reference/main.py:44-45; SURVEY.md §2.3).  Here
@@ -176,9 +176,43 @@ def load_synth(num_samples: int = 10_000, image_size: int = 32,
     return (x * 255).astype(np.uint8), y.astype(np.int64)
 
 
+def load_digits_img(data_dir: str = "", train: bool = True,
+                    download: bool = False) -> Arrays:
+    """Real handwritten-digit images (sklearn's bundled UCI digits), no
+    network needed: the one REAL image dataset available in an egress-free
+    environment.  1,797 8x8 grayscale digits -> nearest-upsampled to 32x32
+    RGB uint8 so the standard augmentation stack (random resized crop at
+    32px, color ops) applies unchanged.  Fills the simple-dataset role the
+    reference delegates to its datasets submodule (main.py:44-45) when the
+    canonical archives (CIFAR/MNIST) cannot be fetched.
+
+    The split is a fixed seeded permutation (1,500 train / 297 test) —
+    sklearn defines no canonical split; pinning one keeps runs comparable.
+    ``data_dir``/``download`` are accepted for ARRAY_LOADERS signature
+    compatibility and ignored (the data ships inside sklearn).
+    """
+    del data_dir, download
+    try:
+        from sklearn.datasets import load_digits as _sk_load
+    except ImportError as e:
+        raise RuntimeError(
+            "--task digits needs scikit-learn (bundles the UCI digits "
+            "images); it is not installed") from e
+    d = _sk_load()
+    x = (d.images / 16.0 * 255.0).astype(np.uint8)      # (1797, 8, 8)
+    x = x.repeat(4, axis=1).repeat(4, axis=2)           # 8x8 -> 32x32
+    x = np.tile(x[..., np.newaxis], (1, 1, 1, 3))       # grayscale -> RGB
+    y = d.target.astype(np.int64)
+    perm = np.random.RandomState(42).permutation(len(x))
+    split = 1500
+    idx = perm[:split] if train else perm[split:]
+    return np.ascontiguousarray(x[idx]), y[idx]
+
+
 ARRAY_LOADERS = {
     "cifar10": (load_cifar10, 10),
     "cifar100": (load_cifar100, 100),
     "mnist": (load_mnist, 10),
     "fashion_mnist": (load_fashion_mnist, 10),
+    "digits": (load_digits_img, 10),
 }
