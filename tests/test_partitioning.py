@@ -170,9 +170,7 @@ def test_tp_same_batch_matches_dp_numerics():
                                float(m_tp["loss_mean"]), rtol=2e-4)
 
 
-@pytest.mark.slow
-def test_sp_ring_vit_train_step(mesh_dp_sp):
-    """Full BYOL train step with ring attention over the sequence axis."""
+def _tiny_vit_arch():
     from byol_tpu.models import registry
     if "vit_sp_test" not in registry.available():
         from byol_tpu.models import vit as vit_lib
@@ -181,6 +179,43 @@ def test_sp_ring_vit_train_step(mesh_dp_sp):
                 vit_lib.ViT(width=32, depth=1, num_heads=4, patch_size=8,
                             dtype=dtype, **kw),
             feature_dim=32, has_batchnorm=False))
+    return "vit_sp_test"
+
+
+@pytest.mark.slow
+def test_dp_sp_tp_combined_mesh_matches_dp():
+    """ALL THREE axes at once — data=2 x sequence=2 x model=2: ViT ring
+    attention over 'sequence' while the projector/predictor shard over
+    'model'.  Loss must match a pure-DP dense-attention run on the same
+    global batch (ring-vs-dense and TP-vs-replicated are each
+    numerics-preserving; the combination must be too)."""
+    arch = _tiny_vit_arch()
+    devices = jax.devices()[:8]
+    mesh_dp = build_mesh(MeshSpec(data=8), devices)
+    mesh_3ax = build_mesh(MeshSpec(data=2, sequence=2, model=2), devices)
+    _, (_, state_dp, step_dp, _, _) = _setup(
+        mesh_dp, data=8, arch=arch, image=32, attn_impl="dense",
+        pooling="gap")
+    _, (_, state_3, step_3, eval_3, _) = _setup(
+        mesh_3ax, data=2, sequence=2, model=2, arch=arch, image=32,
+        attn_impl="ring", pooling="gap")
+    # the TP layout must actually shard the head kernels over 'model'
+    spec = state_3.params["projector"]["dense1"]["kernel"].sharding.spec
+    assert MODEL_AXIS in spec
+    b = _batch(mesh_dp, 8, image=32, seed=5)
+    b2 = _batch(mesh_3ax, 8, image=32, seed=5)
+    _, m_dp = step_dp(state_dp, b)
+    state_3, m_3 = step_3(state_3, b2)
+    np.testing.assert_allclose(float(m_dp["loss_mean"]),
+                               float(m_3["loss_mean"]), rtol=2e-4)
+    ev = eval_3(state_3, b2)
+    assert np.isfinite(float(ev["loss_mean"]))
+
+
+@pytest.mark.slow
+def test_sp_ring_vit_train_step(mesh_dp_sp):
+    """Full BYOL train step with ring attention over the sequence axis."""
+    _tiny_vit_arch()
     _, (_, state, train_step, eval_step, _) = _setup(
         mesh_dp_sp, data=4, sequence=2, arch="vit_sp_test", image=32,
         attn_impl="ring", pooling="gap")
