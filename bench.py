@@ -37,6 +37,7 @@ Usage:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 import traceback
@@ -245,7 +246,6 @@ def _flush_partial():
         # truncating it.  (Learned the hard way: an import-time classifier
         # check once overwrote the committed TPU artifact with a single
         # backend_died stub.)
-        import os
         if _PARTIAL_PATH not in _flushed_paths:
             if os.path.exists(_PARTIAL_PATH):
                 os.replace(_PARTIAL_PATH, _PARTIAL_PATH + ".prev")
@@ -568,13 +568,14 @@ def _sweep(arch, image_size, candidates, mfu_of):
       so re-runs after a tunnel drop finish the grid instead of repeating
       it.
     """
-    rungs = [bs for bs in (512, 384, 256) if bs <= max(candidates)]
+    top = max(candidates)
+    rungs = [bs for bs in (512, 384, 256) if bs <= top]
     if not rungs:        # CPU-fallback ladder (tiny model): keep liveness
         rungs = list(candidates)
     grid = [(remat, fuse, bs)
             for remat in (False, True) for fuse in (True, False)
             for bs in rungs]
-    if max(candidates) >= 1024:
+    if top >= 1024:
         grid += [(True, True, 1024), (True, False, 1024)]
     prior = _sweep_prior_rows() if jax.default_backend() != "cpu" else {}
     rows = []
@@ -630,7 +631,6 @@ def _sweep(arch, image_size, candidates, mfu_of):
                   else "bench_sweep_cpu.json")
     if rows:
         try:
-            import os
             if os.path.exists(sweep_path):
                 # same evidence-preservation contract as _flush_partial: a
                 # partial re-run must never destroy a complete prior table
