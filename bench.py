@@ -33,6 +33,8 @@ Usage:
   python bench.py                  # the two headline configs -> one JSON line
   python bench.py --sweep          # batch x remat x fuse grid -> bench_sweep.json
   python bench.py --profile DIR    # jax.profiler trace of the headline config
+  python bench.py --stem-ab        # conv vs space_to_depth stem A/B
+  python bench.py --data           # host data pipeline: tf vs native C++
 """
 from __future__ import annotations
 
@@ -359,6 +361,9 @@ def _emit_stale_or_die() -> None:
 
 
 def main():
+    if "--data" in sys.argv[1:]:
+        _data_pipeline_bench()     # host-only: no accelerator preflight
+        return
     # Persistent compile cache: every config's XLA compile costs minutes over
     # the tunneled backend; caching makes sweep re-runs (and headline re-runs
     # after a mid-sweep backend drop) nearly free to resume.
@@ -524,6 +529,61 @@ def _profile(arch, image_size, candidates, logdir):
     print(json.dumps({"metric": "profile", "value": bs,
                       "unit": "batch/chip", "vs_baseline": None,
                       "logdir": logdir}))
+
+
+def _data_pipeline_bench():
+    """Host data-layer throughput: tf.data vs the native C++ backend.
+
+    Quantifies the DALI-analog claim (SURVEY §2.4: NVIDIA DALI ->
+    tf.data / custom C++ host pipeline): images/sec of fully-augmented
+    two-view batches produced per host, measured through the real loader
+    path (``get_loader`` -> per-epoch iterators).  Pure host work — runs
+    identically with or without an accelerator attached.
+    """
+    # Host-only measurement, but the loader touches jax (process_index for
+    # per-host sharding) — pin the cpu platform so a wedged TPU tunnel can
+    # never hang what is advertised as a pure-host benchmark.
+    jax.config.update("jax_platforms", "cpu")
+
+    from byol_tpu.core.config import Config, DeviceConfig, TaskConfig
+    from byol_tpu.data import native_aug
+    from byol_tpu.data.loader import get_loader
+
+    size, bs, n = 96, 256, 2048
+    backends = ["tf"] + (["native"] if native_aug.available() else [])
+    rates = {}
+    for backend in backends:
+        cfg = Config(
+            task=TaskConfig(task="synth", batch_size=bs, epochs=1,
+                            image_size_override=size, data_backend=backend),
+            device=DeviceConfig(num_replicas=1, seed=0))
+        bundle = get_loader(cfg, num_synth_samples=n)
+        for _ in bundle.train_loader:          # warm: thread pools, tf graph
+            pass                               # (streaming: one batch live)
+        epochs = 3
+        t0 = time.perf_counter()
+        batches = 0
+        for e in range(epochs):
+            bundle.set_all_epochs(e)
+            for _ in bundle.train_loader:
+                batches += 1
+        dt = time.perf_counter() - t0
+        rates[backend] = bs * batches / dt
+        print(f"bench: data backend {backend}: {rates[backend]:.1f} img/s "
+              f"(two-view {size}px batches, {batches} batches)",
+              file=sys.stderr)
+    if "native" not in rates:
+        print("bench: native C++ backend unavailable (no toolchain/.so); "
+              "reporting tf only", file=sys.stderr)
+    primary = rates.get("native", rates["tf"])
+    print(json.dumps({
+        "metric": "host_data_pipeline_images_per_sec",
+        "value": round(primary, 1),
+        "unit": "images/sec/host",
+        "vs_baseline": (round(rates["native"] / rates["tf"], 3)
+                        if "native" in rates else None),
+        "note": "two-view augmented batches; vs_baseline = native/tf",
+    }))
 
 
 def _sweep_prior_rows() -> dict:
