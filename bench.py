@@ -266,48 +266,11 @@ def _record(name: str, **fields):
     _flush_partial()
 
 
-def _preflight_backend(timeout_s: float = 180.0) -> bool:
-    """Probe backend initialization in a KILLABLE subprocess first.
-
-    A SIGTERM-killed TPU run can wedge the axon tunnel for hours, after
-    which backend init blocks forever inside C — un-interruptible from this
-    process.  Probing in a subprocess turns an unattended infinite hang
-    into a fast, explained failure.  Returns False (with the diagnosis on
-    stderr) when the accelerator is unreachable."""
-    platforms = str(jax.config.jax_platforms or "")
-    if platforms == "cpu":
-        return True  # explicitly pinned to CPU (tests/smokes): no probe
-    # When a non-cpu platform is explicitly configured (e.g. the axon
-    # plugin forces "axon,cpu"), a probe child that lands on cpu means the
-    # accelerator died and jax silently fell back — which must count as
-    # unreachable, not as a healthy backend (the same silent-fallback trap
-    # _reraise_if_backend_dead guards with its platform assert).
-    expect_accel = bool(platforms) and platforms.split(",")[0] != "cpu"
-    import subprocess
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp; "
-             "float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()); "
-             "print(jax.default_backend())"],
-            timeout=timeout_s, capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        print(f"bench: backend failed to initialize within {timeout_s:.0f}s "
-              "— the TPU tunnel is likely wedged (a previously killed TPU "
-              "process leaves it hung for hours).", file=sys.stderr)
-        return False
-    if probe.returncode != 0:
-        print("bench: backend probe failed:\n" + probe.stderr[-2000:],
-              file=sys.stderr)
-        return False
-    child_backend = probe.stdout.strip().splitlines()[-1] if probe.stdout \
-        else ""
-    if expect_accel and child_backend == "cpu":
-        print(f"bench: platforms={platforms!r} configures an accelerator "
-              "but the probe landed on cpu — the accelerator is dead and "
-              "jax silently fell back.", file=sys.stderr)
-        return False
-    return True
+# Killable backend preflight — shared with the train CLI (which learned the
+# hard way that it needs one too: a capture-pipeline train run hung forever
+# in backend init against a dead tunnel where this bench failed fast).
+# Kept under the private name so tests can stub bench._preflight_backend.
+from byol_tpu.core.preflight import preflight_backend as _preflight_backend
 
 
 def _emit_stale_or_die() -> None:

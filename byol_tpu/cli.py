@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import sys
 from typing import List, Optional
 
 from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
@@ -249,6 +250,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.visdom_url or args.visdom_port:
         print("byol_tpu: visdom backend is not supported (SURVEY §5.5); "
               f"metrics go to --grapher={args.grapher} under --log-dir")
+    # Probe the accelerator in a killable subprocess BEFORE anything touches
+    # the local XLA backend: against a wedged TPU tunnel, backend init blocks
+    # forever inside native code and an unattended training job hangs with
+    # no diagnosis (bench.py has carried this guard since round 3; the train
+    # CLI demonstrably hangs without it).
+    from byol_tpu.core import preflight
+    if not preflight.preflight_backend():
+        print("byol_tpu: accelerator backend unreachable (diagnosis above); "
+              "pass --no-cuda to run on CPU, or retry when a probe matmul "
+              "succeeds.", file=sys.stderr)
+        return 2
     # Multi-host rendezvous MUST happen before anything initializes the local
     # XLA backend (config_from_args queries jax.device_count()).  The
     # reference had the same ordering constraint around init_process_group

@@ -1,0 +1,65 @@
+"""Killable accelerator preflight for unattended entry points.
+
+A SIGTERM-killed TPU run can wedge the tunneled backend such that the NEXT
+process's backend initialization blocks forever inside native code — where
+it cannot be interrupted from Python.  An unattended run (a benchmark, a
+scheduled training job, the reference's `sbatch run.sh` analog) then hangs
+with no explanation instead of failing.  The reference had no equivalent
+guard — a dead NCCL peer likewise hung or crashed the job and the operator
+was told to expect it (reference README.md:42); this module is the
+fail-fast upgrade on that story (SURVEY.md §5.3).
+
+The probe runs a matmul WITH a scalar readback in a subprocess that can be
+killed on timeout, and asserts the child actually landed on the configured
+accelerator platform: on a dead accelerator jax silently falls back to cpu,
+which would otherwise make the probe pass and defer the hang (or a
+silent-CPU training run) to the caller.
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import jax
+
+
+def preflight_backend(timeout_s: float = 180.0) -> bool:
+    """Probe backend initialization in a killable subprocess.
+
+    Returns True when the backend is usable (or the run is explicitly
+    pinned to CPU, where there is nothing to probe); False — with the
+    diagnosis on stderr — when the accelerator is unreachable.
+    """
+    platforms = str(jax.config.jax_platforms or "")
+    if platforms == "cpu":
+        return True  # explicitly pinned to CPU (tests/smokes): no probe
+    # When a non-cpu platform is explicitly configured (e.g. a site plugin
+    # forces "axon,cpu"), a probe child that lands on cpu means the
+    # accelerator died and jax silently fell back — which must count as
+    # unreachable, not as a healthy backend.
+    expect_accel = bool(platforms) and platforms.split(",")[0] != "cpu"
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "float((jnp.ones((8,8)) @ jnp.ones((8,8))).sum()); "
+             "print(jax.default_backend())"],
+            timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        print(f"byol_tpu: backend failed to initialize within "
+              f"{timeout_s:.0f}s — the TPU tunnel is likely wedged (a "
+              "previously killed TPU process leaves it hung for hours).",
+              file=sys.stderr)
+        return False
+    if probe.returncode != 0:
+        print("byol_tpu: backend probe failed:\n" + probe.stderr[-2000:],
+              file=sys.stderr)
+        return False
+    child_backend = probe.stdout.strip().splitlines()[-1] if probe.stdout \
+        else ""
+    if expect_accel and child_backend == "cpu":
+        print(f"byol_tpu: platforms={platforms!r} configures an accelerator "
+              "but the probe landed on cpu — the accelerator is dead and "
+              "jax silently fell back.", file=sys.stderr)
+        return False
+    return True

@@ -225,3 +225,27 @@ def test_cli_parser_reference_surface(tmp_path):
     assert cfg.parity.loss_norm_mode == "reference"
     assert cfg.parity.ema_init_mode == "reference"
     assert cfg.parity.schedule_granularity == "epoch"
+
+
+def test_preflight_cpu_pinned_skips_probe(monkeypatch):
+    """Under an explicit cpu pin (the test conftest) there is nothing to
+    probe — no subprocess may be spawned."""
+    import subprocess
+    from byol_tpu.core.preflight import preflight_backend
+
+    def boom(*a, **k):  # pragma: no cover - must not be reached
+        raise AssertionError("probe subprocess must not run under cpu pin")
+    monkeypatch.setattr(subprocess, "run", boom)
+    assert preflight_backend() is True
+
+
+def test_cli_fails_fast_when_backend_unreachable(monkeypatch, capsys):
+    """The train CLI must exit 2 (not hang in backend init) against a dead
+    accelerator — the bench has carried this guard since round 3; a capture
+    -pipeline train run hung forever without it."""
+    from byol_tpu import cli
+    from byol_tpu.core import preflight
+    monkeypatch.setattr(preflight, "preflight_backend", lambda *a, **k: False)
+    rc = cli.main(["--task", "fake", "--batch-size", "16", "--epochs", "1"])
+    assert rc == 2
+    assert "unreachable" in capsys.readouterr().err
