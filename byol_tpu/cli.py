@@ -140,6 +140,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tensor-parallel axis size")
     x.add_argument("--sequence-parallel", type=int, default=1,
                    help="sequence/context-parallel axis size (ViT)")
+    x.add_argument("--dcn-data-parallel", type=int, default=1,
+                   help="ICI slices the data axis spans on multi-slice "
+                        "pods (slice-major layout: gradient/SyncBN "
+                        "all-reduces decompose into in-slice ICI + "
+                        "cross-slice DCN phases)")
     x.add_argument("--fsdp", action="store_true",
                    help="ZeRO-style weight-update sharding: shard the "
                         "optimizer/EMA/Polyak trees over the data axis "
@@ -232,6 +237,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             shard_eval=args.shard_eval,
             model_parallel=args.model_parallel,
             sequence_parallel=args.sequence_parallel,
+            dcn_data_parallel=args.dcn_data_parallel,
             fsdp=args.fsdp),
         parity=ParityConfig(
             loss_norm_mode=args.loss_norm_mode,
@@ -254,13 +260,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     # the local XLA backend: against a wedged TPU tunnel, backend init blocks
     # forever inside native code and an unattended training job hangs with
     # no diagnosis (bench.py has carried this guard since round 3; the train
-    # CLI demonstrably hangs without it).
-    from byol_tpu.core import preflight
-    if not preflight.preflight_backend():
-        print("byol_tpu: accelerator backend unreachable (diagnosis above); "
-              "pass --no-cuda to run on CPU, or retry when a probe matmul "
-              "succeeds.", file=sys.stderr)
-        return 2
+    # CLI demonstrably hangs without it).  Skipped for multi-host runs: a
+    # standalone probe child cannot join a slice-wide TPU runtime (each
+    # host's backend init waits for the whole slice), so the probe would
+    # time out and misdiagnose a healthy pod.  (When jax_platforms is unset
+    # — the normal TPU-VM case — the probe is kept: its subprocess costs
+    # seconds, and its timeout path is the only thing standing between a
+    # wedged runtime and an unattended infinite hang.)
+    if not args.distributed_master:
+        from byol_tpu.core import preflight
+        if not preflight.preflight_backend():
+            print("byol_tpu: accelerator backend unreachable (diagnosis "
+                  "above); pass --no-cuda to run on CPU, or retry when a "
+                  "probe matmul succeeds.", file=sys.stderr)
+            return 2
     # Multi-host rendezvous MUST happen before anything initializes the local
     # XLA backend (config_from_args queries jax.device_count()).  The
     # reference had the same ordering constraint around init_process_group

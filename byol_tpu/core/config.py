@@ -140,6 +140,9 @@ class DeviceConfig:
     # TPU-native mesh shape: data x model x sequence. model/sequence default 1.
     model_parallel: int = 1
     sequence_parallel: int = 1
+    dcn_data_parallel: int = 1          # ICI slices the data axis spans
+                                        # (multi-slice pods: in-slice ICI +
+                                        # cross-slice DCN collectives)
     fsdp: bool = False                  # ZeRO-style weight-update sharding:
                                         # optimizer/EMA/Polyak trees sharded
                                         # over the data axis (params stay

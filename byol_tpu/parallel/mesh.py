@@ -60,10 +60,56 @@ class MeshSpec:
     data: int = -1          # -1: all remaining devices
     sequence: int = 1
     model: int = 1
+    # Number of ICI slices the data axis spans, data-parallel over DCN
+    # (multi-slice / Megascale topologies).  1 = single slice (everything
+    # rides ICI).  See :func:`build_mesh` for the layout contract.
+    dcn_data: int = 1
+
+
+def _slice_granules(devices: Sequence[jax.Device]) -> list:
+    """Group devices into ICI islands ("granules"), DCN between them.
+
+    On multi-slice TPU deployments each device carries a ``slice_index``;
+    elsewhere (single slice, CPU) the process is the best available proxy
+    for the ICI boundary.  Groups are ordered by key so every process
+    builds the identical mesh."""
+    keys = sorted({getattr(d, "slice_index", None) if
+                   getattr(d, "slice_index", None) is not None
+                   else d.process_index for d in devices})
+    by_key = {k: [] for k in keys}
+    for d in devices:
+        k = getattr(d, "slice_index", None)
+        by_key[k if k is not None else d.process_index].append(d)
+    return [by_key[k] for k in keys]
 
 
 def build_mesh(spec: MeshSpec = MeshSpec(),
-               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+               devices: Optional[Sequence[jax.Device]] = None,
+               dcn_granules: Optional[Sequence[Sequence[jax.Device]]] = None
+               ) -> Mesh:
+    """Build the (data, sequence, model) mesh.
+
+    ``spec.dcn_data > 1`` requests the multi-slice layout (SURVEY.md §5.8:
+    collectives ride ICI within a slice and DCN across slices — the
+    reference's NCCL had the analogous NVLink-vs-IB hierarchy managed for
+    it by the NCCL ring builder): the data axis is laid out SLICE-MAJOR
+    (``data index = slice * per_slice_dp + position_within_slice``), with
+    each slice's block containing only ICI-connected devices, so the
+    backend decomposes a data-axis all-reduce into an in-slice ICI phase
+    and a small cross-slice DCN phase.  Device order is identical to
+    ``mesh_utils.create_hybrid_device_mesh([per_slice_dp, seq, model],
+    dcn_mesh_shape=[dcn, 1, 1])`` with the two data factors merged into
+    one named axis — merged so every P('data') annotation, collective,
+    and FSDP rule in the framework works unchanged at multi-slice scale.
+
+    ``sequence``/``model`` axes never span slices (ring attention and TP
+    collectives are latency-sensitive and must stay on ICI); this is
+    enforced, not assumed.
+
+    ``dcn_granules`` overrides slice discovery with an explicit grouping —
+    tests use it to exercise the multi-slice layout on a CPU mesh where
+    every device reports the same process.
+    """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     dp = spec.data
@@ -76,8 +122,37 @@ def build_mesh(spec: MeshSpec = MeshSpec(),
     if dp * spec.sequence * spec.model != n:
         raise ValueError(
             f"mesh {dp}x{spec.sequence}x{spec.model} != {n} devices")
-    arr = np.asarray(devices).reshape(dp, spec.sequence, spec.model)
-    return Mesh(arr, AXIS_NAMES)
+    if spec.dcn_data <= 1 and dcn_granules is None:
+        arr = np.asarray(devices).reshape(dp, spec.sequence, spec.model)
+        return Mesh(arr, AXIS_NAMES)
+
+    granules = ([list(g) for g in dcn_granules] if dcn_granules is not None
+                else _slice_granules(devices))
+    n_slices = spec.dcn_data if spec.dcn_data > 1 else len(granules)
+    if len(granules) != n_slices:
+        raise ValueError(
+            f"dcn_data={n_slices} but the devices form {len(granules)} "
+            "ICI granules (slice/process groups)")
+    if dp % n_slices != 0:
+        raise ValueError(
+            f"data={dp} not divisible by dcn_data={n_slices}")
+    flat = [d for g in granules for d in g]
+    if sorted(map(id, flat)) != sorted(map(id, devices)):
+        raise ValueError(
+            "dcn_granules must be disjoint and exactly cover the devices "
+            f"argument: granules hold {len(flat)} devices "
+            f"({len(set(map(id, flat)))} distinct) vs {len(devices)} given")
+    per_slice = dp // n_slices * spec.sequence * spec.model
+    blocks = []
+    for g in granules:
+        if len(g) != per_slice:
+            raise ValueError(
+                f"granule sizes {[len(x) for x in granules]} != "
+                f"{per_slice} devices per slice "
+                f"(data/dcn_data x sequence x model)")
+        blocks.append(np.asarray(g).reshape(
+            dp // n_slices, spec.sequence, spec.model))
+    return Mesh(np.concatenate(blocks, axis=0), AXIS_NAMES)
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

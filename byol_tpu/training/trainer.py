@@ -108,7 +108,8 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
             cfg.device, num_replicas=n_devices // tp_sp))
     mesh = build_mesh(MeshSpec(data=cfg.device.num_replicas,
                                sequence=cfg.device.sequence_parallel,
-                               model=cfg.device.model_parallel))
+                               model=cfg.device.model_parallel,
+                               dcn_data=cfg.device.dcn_data_parallel))
 
     if loader is None:
         loader = get_loader(cfg, shard_eval=cfg.device.shard_eval)

@@ -249,3 +249,27 @@ def test_cli_fails_fast_when_backend_unreachable(monkeypatch, capsys):
     rc = cli.main(["--task", "fake", "--batch-size", "16", "--epochs", "1"])
     assert rc == 2
     assert "unreachable" in capsys.readouterr().err
+
+
+def test_cli_skips_preflight_on_multihost(monkeypatch):
+    """A standalone probe child cannot join a slice-wide TPU runtime, so
+    distributed runs must skip the preflight (it would time out and
+    misdiagnose a healthy pod) and go straight to rendezvous."""
+    import pytest
+    from byol_tpu import cli
+    from byol_tpu.core import preflight
+    from byol_tpu.parallel import mesh as mesh_lib
+
+    def no_probe(*a, **k):
+        raise AssertionError("preflight must not run on multi-host")
+    monkeypatch.setattr(preflight, "preflight_backend", no_probe)
+
+    class Sentinel(Exception):
+        pass
+
+    def fake_init(addr, num_processes=None, process_id=None):
+        assert addr == "h0:29300"   # port default appended
+        raise Sentinel()
+    monkeypatch.setattr(mesh_lib, "initialize_distributed", fake_init)
+    with pytest.raises(Sentinel):
+        cli.main(["--task", "fake", "--distributed-master", "h0"])
