@@ -54,7 +54,11 @@ def get_backbone(name: str, *, dtype=jnp.float32, small_inputs: bool = False,
 
 def _register_resnets() -> None:
     for name in ("resnet18", "resnet34", "resnet50", "resnet101",
-                 "resnet152", "resnet200", "resnet50w2", "resnet200w2"):
+                 "resnet152", "resnet200", "resnet50w2", "resnet200w2",
+                 # torchvision spellings (the reference's --arch accepts
+                 # any torchvision callable, main.py:30-32); these widen
+                 # only the bottleneck inner convs — feature dim 2048
+                 "wide_resnet50_2", "wide_resnet101_2"):
         def factory(dtype=jnp.float32, small_inputs=False, _n=name, **kw):
             return resnet_lib.make_resnet(_n, dtype=dtype,
                                           small_inputs=small_inputs, **kw)

@@ -97,7 +97,14 @@ class BasicBlock(nn.Module):
 
 
 class Bottleneck(nn.Module):
-    """1x1 -> 3x3 -> 1x1(x4) residual block (resnet50+)."""
+    """1x1 -> 3x3 -> 1x1(x4) residual block (resnet50+).
+
+    ``inner_multiplier`` widens only the two inner convs — torchvision's
+    wide_resnet convention (width_per_group=128), where the block's OUTPUT
+    width (and so the backbone feature dim) stays filters x expansion.
+    The paper-style "2x" variants (resnet50w2 etc.) instead widen every
+    layer via ResNet.width.
+    """
 
     filters: int
     strides: Tuple[int, int] = (1, 1)
@@ -105,16 +112,18 @@ class Bottleneck(nn.Module):
     norm: ModuleDef = nn.BatchNorm
     expansion: int = 4
     zero_init_last_bn: bool = True
+    inner_multiplier: int = 1
 
     @nn.compact
     def __call__(self, x):
         last_scale = (nn.initializers.zeros_init() if self.zero_init_last_bn
                       else nn.initializers.ones_init())
         residual = x
-        y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        inner = self.filters * self.inner_multiplier
+        y = self.conv(inner, (1, 1), name="conv1")(x)
         y = self.norm(name="bn1")(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), self.strides, padding=1,
+        y = self.conv(inner, (3, 3), self.strides, padding=1,
                       name="conv2")(y)
         y = self.norm(name="bn2")(y)
         y = nn.relu(y)
@@ -148,6 +157,9 @@ class ResNet(nn.Module):
     stem: str = "conv"                   # 'conv' | 'space_to_depth' (identical
                                          # numerics, MXU-friendly layout;
                                          # ignored for the CIFAR stem)
+    inner_multiplier: int = 1            # torchvision wide_resnet*_2: widen
+                                         # only the bottleneck inner convs
+                                         # (feature dim unchanged)
 
     @property
     def feature_dim(self) -> int:
@@ -179,13 +191,18 @@ class ResNet(nn.Module):
         if not self.small_inputs:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
+        # BasicBlock has no inner width to widen; only pass the knob where
+        # it exists (wide variants are bottleneck-only, as in torchvision)
+        wide_kw = ({"inner_multiplier": self.inner_multiplier}
+                   if self.inner_multiplier != 1 else {})
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 x = block_cls(filters=self.width * 2 ** i,
                               strides=strides, conv=conv, norm=norm,
                               zero_init_last_bn=self.zero_init_residual,
-                              name=f"stage{i + 1}_block{j + 1}")(x)
+                              name=f"stage{i + 1}_block{j + 1}",
+                              **wide_kw)(x)
         x = jnp.mean(x, axis=(1, 2))     # global average pool
         return x.astype(self.dtype)
 
@@ -205,15 +222,33 @@ def make_resnet(name: str, *, dtype=jnp.float32, width_multiplier: int = 1,
                 small_inputs: bool = False,
                 zero_init_residual: bool = True,
                 remat: bool = False, stem: str = "conv") -> ResNet:
-    base = name.replace("w2", "")
-    if base not in STAGE_SIZES:
-        raise ValueError(f"unknown resnet arch {name!r}; "
-                         f"known: {sorted(STAGE_SIZES)} (+'w2' suffix)")
-    if name.endswith("w2"):
-        width_multiplier = 2
+    """Two widening conventions, both first-class:
+
+    - ``resnetNNw2`` (paper-style "x2", the BYOL paper's RN50(2x)): EVERY
+      layer twice as wide, feature dim doubles (4096 for resnet50w2);
+    - ``wide_resnetNN_2`` (the torchvision names the reference's arch flag
+      accepts, main.py:30-32): only the two bottleneck inner convs widen
+      (width_per_group=128), feature dim stays 2048.
+    """
+    inner_multiplier = 1
+    if name.startswith("wide_") and name.endswith("_2"):
+        base = name[len("wide_"):-len("_2")]
+        if base in BASIC or base not in STAGE_SIZES:
+            raise ValueError(f"unknown wide arch {name!r}; wide variants "
+                             "exist for bottleneck resnets only")
+        inner_multiplier = 2
+    else:
+        base = name.replace("w2", "")
+        if base not in STAGE_SIZES:
+            raise ValueError(f"unknown resnet arch {name!r}; "
+                             f"known: {sorted(STAGE_SIZES)} (+'w2' suffix, "
+                             "+ torchvision 'wide_resnetNN_2' names)")
+        if name.endswith("w2"):
+            width_multiplier = 2
     block = BasicBlock if base in BASIC else Bottleneck
     return ResNet(stage_sizes=STAGE_SIZES[base], block_cls=block,
                   width=64 * width_multiplier, dtype=dtype,
                   small_inputs=small_inputs,
                   zero_init_residual=zero_init_residual,
-                  remat=remat, stem=stem)
+                  remat=remat, stem=stem,
+                  inner_multiplier=inner_multiplier)

@@ -16,6 +16,8 @@ The flax model is built with ``zero_init_residual=False`` to match
 torchvision's default (the gate exists for exactly this parity,
 resnet.py).
 """
+import functools
+
 import numpy as np
 import pytest
 import torch
@@ -48,14 +50,17 @@ class TorchBasicBlock(tnn.Module):
 
 
 class TorchBottleneck(tnn.Module):
-    def __init__(self, cin, width, stride):
+    def __init__(self, cin, width, stride, inner_mult=1):
         super().__init__()
+        # inner_mult=2 is torchvision's wide_resnet*_2 (width_per_group=128):
+        # only the inner convs widen; the block output stays width*4
         cout = width * 4
-        self.conv1 = tnn.Conv2d(cin, width, 1, bias=False)
-        self.bn1 = tnn.BatchNorm2d(width)
-        self.conv2 = tnn.Conv2d(width, width, 3, stride, 1, bias=False)
-        self.bn2 = tnn.BatchNorm2d(width)
-        self.conv3 = tnn.Conv2d(width, cout, 1, bias=False)
+        inner = width * inner_mult
+        self.conv1 = tnn.Conv2d(cin, inner, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(inner)
+        self.conv2 = tnn.Conv2d(inner, inner, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(inner)
+        self.conv3 = tnn.Conv2d(inner, cout, 1, bias=False)
         self.bn3 = tnn.BatchNorm2d(cout)
         self.down = None
         if stride != 1 or cin != cout:
@@ -78,7 +83,7 @@ class TorchResNet(tnn.Module):
         self.stem = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
         self.bn = tnn.BatchNorm2d(64)
         widths = [64, 128, 256, 512]
-        expansion = 4 if block_cls is TorchBottleneck else 1
+        expansion = 1 if block_cls is TorchBasicBlock else 4
         layers, cin = [], 64
         for i, (w, n) in enumerate(zip(widths, stage_sizes)):
             for j in range(n):
@@ -177,6 +182,10 @@ class TestResNetForwardParity:
     @pytest.mark.parametrize("arch,block_cls,stages", [
         ("resnet18", TorchBasicBlock, [2, 2, 2, 2]),
         ("resnet50", TorchBottleneck, [3, 4, 6, 3]),
+        # torchvision wide convention: the two inner convs at 2x, dim 2048
+        ("wide_resnet50_2",
+         functools.partial(TorchBottleneck, inner_mult=2),
+         [3, 4, 6, 3]),
     ])
     def test_eval_mode_uses_running_stats_like_torch(self, arch, block_cls,
                                                      stages):
