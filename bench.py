@@ -54,6 +54,10 @@ _GMACS = {
     ("resnet50", 96): 0.76,
     ("resnet18", 224): 1.814,
     ("resnet18", 32): 0.557,   # CIFAR stem (3x3 s1, no maxpool)
+    # ViT-B/16 @224 (BASELINE.json config 5): 197 tokens; per block
+    # 4*S*D^2 qkvo + 2*S^2*D attn + 8*S*D^2 MLP = 1.454 GMACs, x12 blocks
+    # + 0.116 patch embed = 17.56 GMACs/forward-image.
+    ("vit_b16", 224): 17.56,
 }
 
 # bf16 peak TFLOP/s per chip, keyed by substring of device_kind.
@@ -327,6 +331,26 @@ def main():
     if "--data" in sys.argv[1:]:
         _data_pipeline_bench()     # host-only: no accelerator preflight
         return
+    # Optional arch override (e.g. --arch vit_b16, the BASELINE.json
+    # config-5 encoder swap).  Non-default archs measure into their OWN
+    # partial file so they can never rotate away the committed resnet50
+    # evidence artifact (and the stale-fallback path stays arch-consistent).
+    arch_override = None
+    if "--arch" in sys.argv[1:]:
+        i = sys.argv.index("--arch") + 1
+        if i >= len(sys.argv):
+            raise SystemExit("usage: bench.py --arch <registry name>")
+        arch_override = sys.argv[i]
+        # Fail fast on typos: otherwise every ladder rung "fails to fit"
+        # and the exit misdiagnoses a misspelling as a memory ceiling.
+        from byol_tpu.models.registry import get_spec
+        try:
+            get_spec(arch_override)
+        except ValueError as e:
+            raise SystemExit(f"bench: {e}")
+    global _PARTIAL_PATH
+    if arch_override and arch_override != "resnet50":
+        _PARTIAL_PATH = f"bench_partial_{arch_override}.json"
     # Persistent compile cache: every config's XLA compile costs minutes over
     # the tunneled backend; caching makes sweep re-runs (and headline re-runs
     # after a mid-sweep backend drop) nearly free to resume.
@@ -346,13 +370,18 @@ def main():
         return
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
-        arch, image_size = "resnet50", 224
+        arch, image_size = arch_override or "resnet50", 224
         candidates = [1024, 512, 256, 128, 64, 32]
+        if arch != "resnet50":
+            # Non-default archs start below the 1024 rung: the un-rematted
+            # rn50 bs1024 compile-OOM once took 25+ min and crashed the
+            # remote-compile service — no first contact with a new arch
+            # should risk that rung.
+            candidates = [512, 256, 128, 64, 32]
     else:  # CPU fallback so the bench never hard-fails off-hardware
         arch, image_size = "resnet18", 32
         candidates = [64, 32]
         # CPU smokes must not clobber the committed TPU evidence artifact
-        global _PARTIAL_PATH
         _PARTIAL_PATH = "bench_partial_cpu.json"
 
     flops_per_sample = _flops_per_sample(arch, image_size)
@@ -648,10 +677,16 @@ def _sweep(arch, image_size, candidates, mfu_of):
         _record(name, fit=True, **row)
         print(f"bench: {name}: {val:.1f} img/s/chip "
               f"mfu={row['mfu']}", file=sys.stderr)
-    # CPU-fallback tables must not shadow the committed TPU table, and an
-    # early backend death must not truncate it to [].
-    sweep_path = ("bench_sweep.json" if jax.default_backend() != "cpu"
-                  else "bench_sweep_cpu.json")
+    # CPU-fallback tables must not shadow the committed TPU table, an early
+    # backend death must not truncate it to [], and a non-default arch
+    # writes its OWN table (same isolation contract as _PARTIAL_PATH — a
+    # vit sweep must never rotate away the committed resnet50 table).
+    if jax.default_backend() == "cpu":
+        sweep_path = "bench_sweep_cpu.json"
+    elif arch != "resnet50":
+        sweep_path = f"bench_sweep_{arch}.json"
+    else:
+        sweep_path = "bench_sweep.json"
     if rows:
         try:
             if os.path.exists(sweep_path):

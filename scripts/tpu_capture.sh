@@ -5,23 +5,29 @@
 # Stage order puts NEW information first (the tunnel can drop at any time);
 # the headline re-run goes last: its tpu_first ladder is compile-cached by
 # the sweep, though its fp32 reference_faithful baseline is NOT in the
-# sweep grid and still compiles cold — if the tunnel dies before stage 5,
-# the committed bench_partial.json already carries a full headline run.
+# sweep grid and still compiles cold — if the tunnel dies before the last
+# stage, the committed bench_partial.json already carries a full headline
+# run.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p /tmp/tpu_capture
 
-echo "== 1/5 sweep =="
+echo "== 1/6 sweep =="
 python bench.py --sweep > /tmp/tpu_capture/sweep_stdout.json 2> /tmp/tpu_capture/sweep_stderr.log
 echo "rc=$?"
 cp -f bench_partial.json /tmp/tpu_capture/sweep_partial.json 2>/dev/null
 
-echo "== 2/5 stem A/B =="
+echo "== 2/6 vit_b16 headline (BASELINE config 5) =="
+python bench.py --arch vit_b16 > /tmp/tpu_capture/vit_stdout.json 2> /tmp/tpu_capture/vit_stderr.log
+echo "rc=$?"
+# vit measures into its own partial file; never touches bench_partial.json
+
+echo "== 3/6 stem A/B =="
 python bench.py --stem-ab > /tmp/tpu_capture/stem_ab_stdout.json 2> /tmp/tpu_capture/stem_ab_stderr.log
 echo "rc=$?"
 cp -f bench_partial.json /tmp/tpu_capture/stem_ab_partial.json 2>/dev/null
 
-echo "== 3/5 profile =="
+echo "== 4/6 profile =="
 rm -rf /tmp/byol_profile   # a stale trace must not masquerade as this run's
 python bench.py --profile /tmp/byol_profile > /tmp/tpu_capture/profile_stdout.json 2> /tmp/tpu_capture/profile_stderr.log
 profile_rc=$?
@@ -33,7 +39,7 @@ else
     echo "profile failed rc=$profile_rc; no trace" > /tmp/tpu_capture/trace_top_ops.txt
 fi
 
-echo "== 4/5 synth learning evidence =="
+echo "== 5/6 synth learning evidence =="
 python train.py --task synth --batch-size 512 --epochs 12 \
     --arch resnet18 --image-size-override 32 --head-latent-size 512 \
     --projection-size 128 --lr 0.8 --warmup 2 --fuse-views \
@@ -42,7 +48,7 @@ python train.py --task synth --batch-size 512 --epochs 12 \
     > /tmp/tpu_capture/synth_stdout.log 2> /tmp/tpu_capture/synth_stderr.log
 echo "rc=$?"
 
-echo "== 5/5 headline bench =="
+echo "== 6/6 headline bench =="
 python bench.py > /tmp/tpu_capture/headline_stdout.json 2> /tmp/tpu_capture/headline_stderr.log
 echo "rc=$?"
 cp -f bench_partial.json /tmp/tpu_capture/headline_partial.json 2>/dev/null

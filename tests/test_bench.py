@@ -298,3 +298,33 @@ class TestMFUAccounting:
     def test_unknown_shape_returns_none(self, bench):
         assert bench._flops_per_sample("resnet50", 96) is not None
         assert bench._flops_per_sample("resnet99", 224) is None
+
+
+class TestArchOverride:
+    """--arch (BASELINE config-5 ViT swap) must isolate its evidence file
+    and carry its own FLOPs accounting."""
+
+    def test_vit_arch_uses_own_partial_path(self, bench, monkeypatch):
+        import sys as _sys
+        monkeypatch.setattr(_sys, "argv", ["bench.py", "--arch", "vit_b16"])
+        bench._preflight_backend = lambda *a, **k: False
+        # no committed vit artifact in this cwd -> clean SystemExit, and the
+        # committed resnet artifact path is never consulted or rotated
+        with pytest.raises(SystemExit, match="no committed TPU artifact"):
+            bench.main()
+        assert bench._PARTIAL_PATH == "bench_partial_vit_b16.json"
+        assert not os.path.exists("bench_partial.json.prev")
+
+    def test_vit_flops_accounting(self, bench):
+        # 8 forward-image-equivalents x 17.56 GMACs x 2 FLOPs/MAC
+        assert bench._flops_per_sample("vit_b16", 224) == pytest.approx(
+            8 * 17.56 * 2 * 1e9)
+
+    def test_unknown_arch_has_no_mfu(self, bench):
+        assert bench._flops_per_sample("resnet200w2", 224) is None
+
+    def test_arch_typo_fails_fast(self, bench, monkeypatch):
+        import sys as _sys
+        monkeypatch.setattr(_sys, "argv", ["bench.py", "--arch", "vit_b_16"])
+        with pytest.raises(SystemExit, match="unknown arch"):
+            bench.main()
