@@ -60,23 +60,9 @@ _GMACS = {
     ("vit_b16", 224): 17.56,
 }
 
-# bf16 peak TFLOP/s per chip, keyed by substring of device_kind.
-_PEAK_TFLOPS = (
-    ("v5 lite", 197.0),   # v5e
-    ("v5e", 197.0),
-    ("v5p", 459.0),
-    ("v6", 918.0),        # Trillium
-    ("v4", 275.0),
-    ("v3", 123.0),
-)
-
-
-def _chip_peak_tflops() -> float | None:
-    kind = jax.devices()[0].device_kind.lower()
-    for key, peak in _PEAK_TFLOPS:
-        if key in kind:
-            return peak
-    return None
+# Chip peak table lives with the framework's MFU accounting (the trainer
+# reports live MFU from the same source, observability/flops.py).
+from byol_tpu.observability.flops import chip_peak_tflops as _chip_peak_tflops
 
 
 def _flops_per_sample(arch: str, image_size: int) -> float | None:

@@ -306,7 +306,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     result = fit(cfg, loader=loader)
     print(f"done: epoch {result.epoch}, test loss "
           f"{result.test_metrics.get('loss_mean', float('nan')):.4f}, "
-          f"{result.images_per_sec_per_chip:.1f} images/sec/chip")
+          f"{result.images_per_sec_per_chip:.1f} images/sec/chip"
+          + (f" (MFU {result.mfu:.1%})" if result.mfu is not None else ""))
     if args.linear_eval:
         import jax
         if jax.process_count() > 1:

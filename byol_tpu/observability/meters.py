@@ -93,6 +93,18 @@ class StepTimer:
         self.global_batch = global_batch
         self.n_chips = max(n_chips, 1)
         self._rate = 0.0
+        self._flops_per_sample: Optional[float] = None
+        self._peak_tflops: Optional[float] = None
+
+    def set_flops(self, flops_per_sample: Optional[float],
+                  peak_tflops: Optional[float]) -> None:
+        """Arm MFU reporting (observability.flops); either None disarms."""
+        self._flops_per_sample = flops_per_sample
+        self._peak_tflops = peak_tflops
+
+    def mfu(self) -> Optional[float]:
+        from byol_tpu.observability.flops import mfu as _mfu
+        return _mfu(self._rate, self._flops_per_sample, self._peak_tflops)
 
     def record_epoch(self, steps: int, elapsed_s: float) -> None:
         """Record one epoch's synchronized (steps, wall-clock) measurement;

@@ -51,6 +51,9 @@ class FitResult:
     test_metrics: Dict[str, float]
     stopped_early: bool
     images_per_sec_per_chip: float
+    mfu: Optional[float] = None          # model-FLOPs utilization per chip
+                                         # (None off-TPU / when XLA cost
+                                         # analysis is unavailable)
 
 
 def _pad_eval_batch(batch: Dict[str, np.ndarray], target: int
@@ -194,6 +197,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     resume_epoch = init_epoch
 
     timer = StepTimer(rcfg.global_batch_size, n_devices)
+    flops_resolved = False
     train_metrics: Dict[str, float] = {}
     test_metrics: Dict[str, float] = {}
     stopped = False
@@ -257,6 +261,19 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
 
         # double-buffered H2D: batch N+1 transfers while step N computes
         for dev_batch in prefetch_to_mesh(tapped_batches(), mesh):
+            if not flops_resolved:
+                # Once per fit: FLOPs of the real train step via XLA cost
+                # analysis (observability/flops.py) -> MFU next to every
+                # throughput number.  Lowering only traces; must precede
+                # the first call because the step donates its input state.
+                flops_resolved = True
+                from byol_tpu.observability import flops as flops_lib
+                with mesh:
+                    step_flops = flops_lib.cost_analysis_flops(
+                        train_step, state, dev_batch)
+                if step_flops:
+                    timer.set_flops(step_flops / rcfg.global_batch_size,
+                                    flops_lib.chip_peak_tflops())
             state, metrics = train_step(state, dev_batch)
             acc.update(metrics)  # device-side running sum; no host sync
             _maybe_preempt_save()
@@ -306,6 +323,9 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                            epoch)
         grapher.add_scalar("images_per_sec_per_chip",
                            timer.images_per_sec_per_chip(), epoch)
+        epoch_mfu = timer.mfu()
+        if epoch_mfu is not None:
+            grapher.add_scalar("mfu_scalar", epoch_mfu, epoch)
         if sample_batch is not None:
             grapher.register_images(
                 {"aug1_imgs": sample_batch["view1"],
@@ -340,4 +360,5 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     grapher.close()
     return FitResult(state=state, epoch=epoch, train_metrics=train_metrics,
                      test_metrics=test_metrics, stopped_early=stopped,
-                     images_per_sec_per_chip=timer.images_per_sec_per_chip())
+                     images_per_sec_per_chip=timer.images_per_sec_per_chip(),
+                     mfu=timer.mfu())

@@ -130,3 +130,33 @@ def test_metric_accumulator_weighted_by_valid_count():
     out = acc.result()
     assert out["top1_mean"] == 75.0          # (100*3 + 0*1) / 4
     assert "_weight" not in out
+
+
+class TestFlopsAccounting:
+    """observability/flops.py: XLA cost analysis vs the bench hand table,
+    pinned against each other so neither silently drifts."""
+
+    def test_cost_analysis_matches_hand_table(self):
+        import bench
+        from byol_tpu.observability import flops as fl
+        state, train_step, batch = bench._build(
+            8, 32, "resnet18", half=False, fuse_views=True,
+            ema_update_mode="post")
+        got = fl.cost_analysis_flops(train_step, state, batch)
+        assert got is not None
+        # cost analysis is of the pre-partitioning (logical) HLO: whole
+        # global batch, which _build sizes as 8 x n_devices
+        import jax
+        per_sample = got / (8 * len(jax.devices()))
+        hand = bench._flops_per_sample("resnet18", 32)
+        # hand table counts backward as exactly 2x forward; XLA counts the
+        # true backward (first conv needs no input grad) -> ~0.88 ratio
+        assert 0.7 < per_sample / hand < 1.1, (per_sample, hand)
+
+    def test_mfu_none_off_accelerator(self):
+        import pytest
+        from byol_tpu.observability.flops import chip_peak_tflops, mfu
+        assert chip_peak_tflops("cpu") is None
+        assert mfu(100.0, 1e9, None) is None
+        assert mfu(100.0, None, 197.0) is None
+        assert mfu(776.1, 65.4e9, 197.0) == pytest.approx(0.2577, abs=2e-3)
