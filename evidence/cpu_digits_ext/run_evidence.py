@@ -1,0 +1,37 @@
+"""Extended REAL-image evidence run: digits task, 16 epochs (2x the
+committed evidence/cpu_digits run), same config/seed otherwise.
+
+Runs PREEMPTIBLE at nice 19: the TPU watcher SIGTERMs it before any
+capture (checkpoint + exit 143); relaunching this driver resumes
+byte-exactly (the framework's tested preemption path).
+"""
+import sys
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_compile_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
+                                  OptimConfig, TaskConfig)
+from byol_tpu.data.loader import get_loader
+from byol_tpu.training.trainer import fit
+from byol_tpu.training.linear_eval import run_linear_eval_from_cfg
+
+cfg = Config(
+    task=TaskConfig(task="digits", batch_size=64, epochs=16,
+                    image_size_override=16, log_dir="/tmp/digits_ext_runs",
+                    uid="digits_ext", grapher="both"),
+    model=ModelConfig(arch="resnet18", head_latent_size=64,
+                      projection_size=32, fuse_views=True,
+                      model_dir="/tmp/digits_ext_models"),
+    optim=OptimConfig(lr=0.4, warmup=1, optimizer="lars_momentum"),
+    device=DeviceConfig(num_replicas=8, half=False, seed=11),
+)
+loader = get_loader(cfg)
+result = fit(cfg, loader=loader)
+le = run_linear_eval_from_cfg(cfg, result.state, loader=loader, seed=11)
+print(f"linear_eval: top1={le.top1:.1f} top5={le.top5:.1f} "
+      f"train_acc={le.train_acc:.1f} n={le.num_train}/{le.num_test}")
