@@ -53,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--num-synth-samples", type=int, default=0,
                    help="dataset size for --task synth (test = 1/10th); "
                         "0 = default 20000")
+    t.add_argument("--valid-fraction", type=float, default=0.0,
+                   help="hold out this fraction of train as a validation "
+                        "split (num_valid_samples contract, reference "
+                        "main.py:421-423); image_folder also accepts an "
+                        "on-disk valid/ root, which wins")
     # Model (main.py:56-70)
     m = p.add_argument_group("model")
     m.add_argument("--arch", type=str, default="resnet50")
@@ -198,7 +203,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             log_dir=args.log_dir, uid=args.uid,
             grapher=args.grapher,
             data_backend=args.data_backend,
-            num_synth_samples=args.num_synth_samples),
+            num_synth_samples=args.num_synth_samples,
+            valid_fraction=args.valid_fraction),
         model=ModelConfig(
             arch=args.arch,
             representation_size=(args.representation_size
@@ -310,19 +316,16 @@ def main(argv: Optional[List[str]] = None) -> int:
           + (f" (MFU {result.mfu:.1%})" if result.mfu is not None else ""))
     if args.linear_eval:
         import jax
-        if jax.process_count() > 1:
-            # the extractor jit closes over pod-global state while batches
-            # are host-local (linear_eval.py module docstring) — run the
-            # protocol single-host on the saved checkpoint instead
-            print("linear_eval: skipped on multi-host runs; restore the "
-                  "checkpoint on one host and re-run with --linear-eval")
-        else:
-            from byol_tpu.training.linear_eval import run_linear_eval_from_cfg
-            le = run_linear_eval_from_cfg(cfg, result.state, loader=loader,
-                                          seed=cfg.device.seed)
-            print(f"linear_eval(offline): top1 {le.top1:.2f} "
-                  f"top5 {le.top5:.2f} (train acc {le.train_acc:.2f}, "
-                  f"{le.num_train} train / {le.num_test} test)")
+        from byol_tpu.training.linear_eval import run_linear_eval_from_cfg
+        # Multi-host: SPMD extraction over the training mesh — every host
+        # computes and prints the identical result (linear_eval.py module
+        # docstring).  Single-host: plain single-jit path.
+        mesh = result.mesh if jax.process_count() > 1 else None
+        le = run_linear_eval_from_cfg(cfg, result.state, loader=loader,
+                                      mesh=mesh, seed=cfg.device.seed)
+        print(f"linear_eval(offline): top1 {le.top1:.2f} "
+              f"top5 {le.top5:.2f} (train acc {le.train_acc:.2f}, "
+              f"{le.num_train} train / {le.num_test} test)")
     return 0
 
 

@@ -45,6 +45,11 @@ class TaskConfig:
     # 1/10th); committed evidence runs use this to stay reproducible from
     # the CLI alone.  0 = loader default (20k).
     num_synth_samples: int = 0
+    # Fraction of the train split held out as a validation set (the
+    # datasets-submodule loaders exposed num_valid_samples, reference
+    # main.py:421-423).  0 = no valid split.  image_folder also accepts an
+    # on-disk valid/ root, which wins over the fraction.
+    valid_fraction: float = 0.0
 
 
 @_frozen
@@ -202,6 +207,7 @@ class ResolvedConfig:
     total_train_steps: int                  # ref main.py:425
     batch_size_per_replica: int             # global // num_replicas (ref main.py:725)
     representation_size: int                # derived from arch registry (fixes Q8)
+    num_valid_samples: int = 0              # per-replica (ref main.py:423)
 
     @property
     def global_batch_size(self) -> int:
@@ -210,12 +216,15 @@ class ResolvedConfig:
 
 def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
             output_size: int, input_shape: Tuple[int, int, int],
-            representation_size: Optional[int] = None) -> ResolvedConfig:
+            representation_size: Optional[int] = None,
+            num_valid_samples: int = 0) -> ResolvedConfig:
     """Derive load-bearing quantities exactly as the reference does.
 
     Reference math (main.py:420-425,725):
       - per-replica batch  = global_batch // num_replicas
       - per-replica train samples = num_train_samples // num_replicas
+      - per-replica valid samples = num_valid_samples // num_replicas
+        (main.py:423 divides valid like train; test stays global)
       - steps_per_train_epoch = per_replica_samples // per_replica_batch  (drop remainder)
       - total_train_steps = epochs * steps_per_train_epoch
     These feed the EMA tau schedule (main.py:160,425) so they must match.
@@ -251,6 +260,7 @@ def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
         total_train_steps=cfg.task.epochs * steps_per_epoch,
         batch_size_per_replica=per_replica_batch,
         representation_size=rep_size,
+        num_valid_samples=num_valid_samples // n_rep,
     )
 
 

@@ -100,12 +100,31 @@ def image_folder_loader(cfg: Config, *, host_batch: int,
     te_paths, te_labels, te_classes = roots["test"]
     if te_classes != classes:
         raise ValueError("train/ and test/ class sets differ")
-    n_train, n_test = len(tr_paths), len(te_paths)
+
+    # Validation split (reference main.py:421-423): an on-disk valid/ root
+    # wins; otherwise valid_fraction carves a seeded held-out head from the
+    # train list BEFORE host sharding, so every host agrees on the split.
+    va_paths, va_labels = [], []
+    valid_root = os.path.join(cfg.task.data_dir, "valid")
+    if os.path.isdir(valid_root):
+        va_paths, va_labels, va_classes = scan_image_folder(valid_root)
+        if va_classes != classes:
+            raise ValueError("train/ and valid/ class sets differ")
+    elif cfg.task.valid_fraction > 0:
+        from byol_tpu.data.loader import carve_valid_split
+        va_idx, tr_idx = carve_valid_split(
+            len(tr_paths), cfg.task.valid_fraction, seed)
+        va_paths = [tr_paths[i] for i in va_idx]
+        va_labels = [tr_labels[i] for i in va_idx]
+        tr_paths = [tr_paths[i] for i in tr_idx]
+        tr_labels = [tr_labels[i] for i in tr_idx]
+    n_train, n_test, n_valid = len(tr_paths), len(te_paths), len(va_paths)
 
     def shard(paths, labels):
         return paths[index::count], labels[index::count]
 
     tr_sh = shard(tr_paths, tr_labels)
+    va_sh = shard(va_paths, va_labels)
     te_sh = shard(te_paths, te_labels) if shard_eval else (te_paths, te_labels)
 
     def make_iter(paths, labels, train: bool
@@ -163,4 +182,8 @@ def image_folder_loader(cfg: Config, *, host_batch: int,
         num_test_samples=n_test,
         output_size=len(classes),
         make_train_eval_iter=make_iter(*tr_sh, train=False),
+        eval_sharded=shard_eval and count > 1,
+        make_valid_iter=(make_iter(*va_sh, train=False) if n_valid
+                         else None),
+        num_valid_samples=n_valid,
     )

@@ -52,6 +52,17 @@ class LoaderBundle:
     # the offline linear-eval protocol trains its probe on (training/
     # linear_eval.py).  Optional: None for hand-built test bundles.
     make_train_eval_iter: Optional[Callable[[int], Iterator[Batch]]] = None
+    # Whether the TEST split was sharded per host at build time (get_loader's
+    # shard_eval).  Consumers (multi-host linear eval) key de-duplication off
+    # this rather than re-reading the config, so a caller-built loader can't
+    # silently disagree with the flag it was built under.
+    eval_sharded: bool = False
+    # Validation split (reference main.py:421-423: the datasets submodule
+    # exposed num_valid_samples next to train/test; sharded per host like
+    # train).  Built when cfg.task.valid_fraction > 0 or, for image_folder,
+    # when a valid/ root exists on disk.  Eval transform (resize-only).
+    make_valid_iter: Optional[Callable[[int], Iterator[Batch]]] = None
+    num_valid_samples: int = 0
 
     def set_all_epochs(self, epoch: int) -> None:
         self.epoch = epoch
@@ -70,6 +81,53 @@ class LoaderBundle:
             raise ValueError("this LoaderBundle provides no train-eval "
                              "(resize-only train split) iterator")
         return self.make_train_eval_iter(self.epoch)
+
+    @property
+    def valid_loader(self) -> Iterator[Batch]:
+        if self.make_valid_iter is None:
+            raise ValueError(
+                "this LoaderBundle has no validation split: set "
+                "--valid-fraction > 0 (or provide a valid/ root for "
+                "image_folder)")
+        return self.make_valid_iter(self.epoch)
+
+
+def pad_batch(batch: Batch, target: int) -> Batch:
+    """Pad a (possibly short) batch up to ``target`` rows and attach a
+    validity ``mask`` (1.0 = real row).  Every eval batch then has ONE
+    static shape — a single XLA compile — and a final batch that isn't
+    divisible by the mesh's data axis still shards cleanly.  Consumers
+    (trainer eval step, linear-eval extraction) mask pad rows out of every
+    metric."""
+    n = len(next(iter(batch.values())))
+    if n > target:
+        raise ValueError(
+            f"pad_batch: batch has {n} rows > target {target}; the caller's "
+            "host batch derivation disagrees with the loader's batch size")
+    mask = np.zeros((target,), np.float32)
+    mask[:n] = 1.0
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        if n < target:
+            pad = np.zeros((target - n,) + v.shape[1:], v.dtype)
+            v = np.concatenate([v, pad], axis=0)
+        out[k] = v
+    out["mask"] = mask
+    return out
+
+
+def carve_valid_split(n: int, fraction: float, seed: int
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """-> (valid_indices, train_indices): the seeded permutation's head is
+    held out (reference main.py:421-423 num_valid_samples contract).  ONE
+    implementation shared by the array and image_folder paths so both tasks
+    split identically and every host agrees."""
+    if not 0.0 <= fraction < 1.0:
+        raise ValueError(f"valid_fraction must be in [0, 1), got {fraction}")
+    n_valid = int(n * fraction)
+    perm = np.random.RandomState(seed ^ 0x5eed).permutation(n)
+    return perm[:n_valid], perm[n_valid:]
 
 
 def _process_info() -> Tuple[int, int]:
@@ -278,8 +336,22 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
     else:
         raise ValueError(f"unknown task {task!r}")
 
+    # Validation carve-out (reference main.py:421-423 contract): held out
+    # BEFORE host sharding so every host agrees on the split; valid is then
+    # sharded per host like train.
+    x_va = y_va = None
+    n_valid = 0
+    if cfg.task.valid_fraction > 0:
+        va_idx, tr_idx = carve_valid_split(
+            len(x_tr), cfg.task.valid_fraction, cfg.device.seed)
+        n_valid = len(va_idx)
+        x_va, y_va = x_tr[va_idx], y_tr[va_idx]
+        x_tr, y_tr = x_tr[tr_idx], y_tr[tr_idx]
+
     n_train, n_test = len(x_tr), len(x_te)
     x_trs, y_trs = _shard_arrays(x_tr, y_tr, index, count)
+    if n_valid:
+        x_va, y_va = _shard_arrays(x_va, y_va, index, count)
     if shard_eval:
         x_te, y_te = _shard_arrays(x_te, y_te, index, count)
 
@@ -312,4 +384,10 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
         num_train_samples=n_train,
         num_test_samples=n_test,
         output_size=n_classes,
+        eval_sharded=shard_eval and count > 1,
+        make_valid_iter=(test_pipeline(
+            x_va, y_va, batch_size=host_batch, image_size=size, train=False,
+            color_jitter_strength=cj, seed=cfg.device.seed, shuffle=False)
+            if n_valid else None),
+        num_valid_samples=n_valid,
     )

@@ -15,14 +15,23 @@ logistic regression, streaming feature batches to the device (at ImageNet
 scale the feature matrix is ~10 GB — it must not live in HBM).  Probe FLOPs
 are trivial next to extraction.
 
-Single-process only: the extractor jit closes over the training state as
+Multi-host (pod) path: the extractor jit closes over the training state as
 placed by ``fit()``, which on a pod spans all hosts' devices while each
-host's loader yields different local data — gate callers on
-``jax.process_count() == 1`` (cli.py does).
+host's loader yields different local data — so extraction must itself be an
+SPMD program.  :func:`extract_features_spmd` assembles each host's local
+batch into a global array on the mesh (``shard_batch_to_mesh``), runs the
+frozen encoder once across the pod, and all-gathers features + labels back
+to every host (replicated ``out_shardings`` — the gather rides ICI/DCN,
+exactly where the reference leaned on NCCL).  Every host then holds the
+full global feature matrix and fits the probe deterministically (same
+seed), so every host reports identical top-1/5 — the paper's headline
+metric computed ON the pod configuration (reference concurrent probe:
+main.py:249-252; BASELINE.md north star).
 """
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -63,6 +72,107 @@ def extract_features(apply_fn: Callable, batches: Iterator[Dict[str, Any]],
         f = np.asarray(apply_fn(x))[:n]
         feats.append(f.astype(np.float32))
         labels.append(y)
+    return np.concatenate(feats), np.concatenate(labels)
+
+
+def _lockstep_status(status: int) -> np.ndarray:
+    """All-gather one per-host status code (0=drained, 1=has data, 2=error).
+
+    Hosts' shard sizes can differ by one batch (interleaved image_folder
+    shards), so extraction iterates in lockstep until every host is drained
+    — a host that finished early keeps feeding all-pad batches rather than
+    deadlocking the collective.  The error code lets a host that CANNOT
+    continue (empty shard, no shape template) fail every peer in the same
+    round instead of leaving them blocked in the next collective."""
+    if jax.process_count() == 1:
+        return np.asarray([status])
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(
+        np.asarray([status], np.int32))).reshape(-1)
+
+
+def encoder_extractor_spmd(net, state, mesh, *, half: bool = False
+                           ) -> Callable:
+    """SPMD frozen-encoder extractor: ``(x, y, mask)`` global arrays in,
+    REPLICATED ``(features_fp32, y, mask)`` out — the replicated
+    out_shardings is the cross-host all-gather, so every host can read the
+    full result with a plain ``np.asarray``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from byol_tpu.core.precision import get_policy
+    policy = get_policy(half)
+    rep = NamedSharding(mesh, P())
+
+    @functools.partial(jax.jit, out_shardings=(rep, rep, rep))
+    def apply(x, y, mask):
+        out = net.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            policy.cast_to_compute(x), train=False, mutable=False)
+        return out["representation"].astype(jnp.float32), y, mask
+
+    return apply
+
+
+def extract_features_spmd(apply_fn, batches: Iterator[Dict[str, Any]], mesh,
+                          *, host_batch: int, view: str = "view1",
+                          replicated_data: bool = False,
+                          sample_shape: Optional[Tuple[int, ...]] = None
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Multi-host feature extraction over per-host loader shards.
+
+    Each host pads its local batch to ``host_batch`` rows (one static shape,
+    one compile), places it on the mesh's data axis, and the SPMD
+    ``apply_fn`` returns the pod-global features + labels + validity mask
+    replicated to every host; pad rows are dropped by the mask.  Sample
+    ORDER across hosts is whatever the mesh's process interleaving gives —
+    irrelevant here because features and labels travel together.
+
+    ``replicated_data=True`` declares that every host iterates the SAME data
+    (the unsharded test set, Quirk Q9): the batches are dealt round-robin —
+    host p keeps batches p, p+P, ... — so each sample is encoded exactly
+    once and the extraction takes 1/P the steps instead of masking
+    (P-1)/P of the pod's work away."""
+    import itertools
+
+    from byol_tpu.data.loader import pad_batch
+    from byol_tpu.parallel.mesh import shard_batch_to_mesh
+
+    feats, labels = [], []
+    # (img_shape, img_dtype) for all-pad batches; ``sample_shape`` seeds it
+    # so a host dealt ZERO batches (fewer eval batches than hosts) can still
+    # feed pad batches instead of failing the pod
+    template = (tuple(sample_shape), np.float32) if sample_shape else None
+    it = iter(batches)
+    if replicated_data and jax.process_count() > 1:
+        it = itertools.islice(it, jax.process_index(), None,
+                              jax.process_count())
+    while True:
+        batch = next(it, None)
+        status = 1 if batch is not None else 0
+        if batch is None and template is None:
+            status = 2         # cannot even feed pad batches: no shape known
+        statuses = _lockstep_status(status)
+        if (statuses == 2).any():
+            raise ValueError(
+                f"eval extraction cannot proceed: host(s) "
+                f"{np.nonzero(statuses == 2)[0].tolist()} have an empty "
+                "shard and no batch-shape template; use equal-size shards "
+                "or shard_eval=False")
+        if not (statuses == 1).any():
+            break
+        if batch is not None:
+            x = np.asarray(batch[view])
+            y = np.asarray(batch["label"], np.int32)
+            template = (x.shape[1:], x.dtype)
+        else:
+            x = np.zeros((0,) + template[0], template[1])
+            y = np.zeros((0,), np.int32)
+        dev = shard_batch_to_mesh(pad_batch({"x": x, "y": y}, host_batch),
+                                  mesh)
+        f, gy, gm = apply_fn(dev["x"], dev["y"], dev["mask"])
+        keep = np.asarray(gm) > 0.5
+        feats.append(np.asarray(f)[keep].astype(np.float32))
+        labels.append(np.asarray(gy)[keep])
     return np.concatenate(feats), np.concatenate(labels)
 
 
@@ -120,13 +230,11 @@ def train_linear_probe(train_x: np.ndarray, train_y: np.ndarray,
     return w, b.reshape(-1)
 
 
-def linear_eval(apply_fn: Callable, train_batches: Iterator,
-                test_batches: Iterator, num_classes: int, *,
-                epochs: int = 30, lr: float = 0.1, seed: int = 0
-                ) -> LinearEvalResult:
-    """Full offline protocol: extract -> fit probe -> report top-1/5."""
-    train_x, train_y = extract_features(apply_fn, train_batches)
-    test_x, test_y = extract_features(apply_fn, test_batches)
+def fit_and_score(train_x: np.ndarray, train_y: np.ndarray,
+                  test_x: np.ndarray, test_y: np.ndarray, num_classes: int,
+                  *, epochs: int = 30, lr: float = 0.1, seed: int = 0
+                  ) -> LinearEvalResult:
+    """Fit the probe on extracted features and report top-1/5."""
     w, b = train_linear_probe(train_x, train_y, num_classes,
                               epochs=epochs, lr=lr, seed=seed)
 
@@ -151,6 +259,17 @@ def linear_eval(apply_fn: Callable, train_batches: Iterator,
                             num_train=len(train_y), num_test=len(test_y))
 
 
+def linear_eval(apply_fn: Callable, train_batches: Iterator,
+                test_batches: Iterator, num_classes: int, *,
+                epochs: int = 30, lr: float = 0.1, seed: int = 0
+                ) -> LinearEvalResult:
+    """Full offline protocol: extract -> fit probe -> report top-1/5."""
+    train_x, train_y = extract_features(apply_fn, train_batches)
+    test_x, test_y = extract_features(apply_fn, test_batches)
+    return fit_and_score(train_x, train_y, test_x, test_y, num_classes,
+                         epochs=epochs, lr=lr, seed=seed)
+
+
 def encoder_apply_fn(net, state, *, half: bool = False) -> Callable:
     """Jitted frozen-encoder feature extractor from a TrainState."""
     from byol_tpu.core.precision import get_policy
@@ -166,10 +285,17 @@ def encoder_apply_fn(net, state, *, half: bool = False) -> Callable:
     return apply
 
 
-def run_linear_eval_from_cfg(cfg, state, *, loader=None, epochs: int = 30,
-                             seed: int = 0) -> LinearEvalResult:
+def run_linear_eval_from_cfg(cfg, state, *, loader=None, mesh=None,
+                             epochs: int = 30, seed: int = 0
+                             ) -> LinearEvalResult:
     """Convenience driver: rebuild the encoder from ``cfg``, extract
-    resize-only features for the train/test splits, fit + score the probe."""
+    resize-only features for the train/test splits, fit + score the probe.
+
+    Pass the training ``mesh`` (``FitResult.mesh``) to run the SPMD
+    extraction path — REQUIRED on multi-host runs, where the state spans the
+    pod and each host's loader yields only its shard; every host then
+    returns the identical result.  Single-host with ``mesh=None`` keeps the
+    plain single-jit path."""
     from byol_tpu.core.config import resolve
     from byol_tpu.data.loader import get_loader
     from byol_tpu.training.build import build_net
@@ -181,7 +307,28 @@ def run_linear_eval_from_cfg(cfg, state, *, loader=None, epochs: int = 30,
                    output_size=loader.output_size,
                    input_shape=loader.input_shape)
     net = build_net(rcfg)
-    apply_fn = encoder_apply_fn(net, state, half=cfg.device.half)
-    return linear_eval(apply_fn, loader.train_eval_loader,
-                       loader.test_loader, loader.output_size,
-                       epochs=epochs, seed=seed)
+    if mesh is None:
+        if jax.process_count() > 1:
+            raise ValueError(
+                "multi-host linear eval needs the training mesh "
+                "(pass mesh=FitResult.mesh)")
+        apply_fn = encoder_apply_fn(net, state, half=cfg.device.half)
+        return linear_eval(apply_fn, loader.train_eval_loader,
+                           loader.test_loader, loader.output_size,
+                           epochs=epochs, seed=seed)
+    host_batch = rcfg.global_batch_size // jax.process_count()
+    apply_fn = encoder_extractor_spmd(net, state, mesh,
+                                      half=cfg.device.half)
+    train_x, train_y = extract_features_spmd(
+        apply_fn, loader.train_eval_loader, mesh, host_batch=host_batch,
+        sample_shape=loader.input_shape)
+    # Quirk Q9: with an unsharded test split every host iterates the FULL
+    # test set — deal the batches round-robin so each sample is encoded
+    # once.  The flag comes from how the LOADER was built (not the config),
+    # so a caller-supplied loader can't silently mismatch.
+    eval_sharded = getattr(loader, "eval_sharded", cfg.device.shard_eval)
+    test_x, test_y = extract_features_spmd(
+        apply_fn, loader.test_loader, mesh, host_batch=host_batch,
+        replicated_data=not eval_sharded, sample_shape=loader.input_shape)
+    return fit_and_score(train_x, train_y, test_x, test_y,
+                         loader.output_size, epochs=epochs, seed=seed)

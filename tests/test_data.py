@@ -57,6 +57,26 @@ class TestFakeLoader:
         test_counts = [b["label"].shape[0] for b in bundle.test_loader]
         assert sum(test_counts) == 16            # full test set kept
 
+    def test_valid_split_carved_from_train(self):
+        # num_valid_samples contract (reference main.py:421-423): a seeded
+        # held-out fraction of train, resize-only transform, disjoint sizes
+        cfg = Config(
+            task=TaskConfig(task="fake", batch_size=8,
+                            image_size_override=24, valid_fraction=0.25),
+            device=DeviceConfig(num_replicas=1, seed=7))
+        bundle = get_loader(cfg, num_fake_samples=64)
+        assert bundle.num_valid_samples == 16
+        assert bundle.num_train_samples == 48
+        batches = list(bundle.valid_loader)
+        assert sum(len(b["label"]) for b in batches) == 16
+        np.testing.assert_array_equal(batches[0]["view1"],
+                                      batches[0]["view2"])  # eval transform
+        # default: no valid split, and the property says how to get one
+        none_bundle = get_loader(_fake_cfg(), num_fake_samples=64)
+        assert none_bundle.num_valid_samples == 0
+        with pytest.raises(ValueError, match="valid"):
+            none_bundle.valid_loader
+
     def test_epoch_reseed_changes_order(self):
         # set_all_epochs analog of the DistributedSampler epoch reshuffle
         # (main.py:760)
@@ -104,6 +124,37 @@ class TestImageFolder:
         te_batch = next(bundle.train_eval_loader)
         np.testing.assert_array_equal(te_batch["view1"], te_batch["view2"])
         assert te_batch["view1"].shape == (4, 32, 32, 3)
+
+    def test_valid_root_on_disk(self, tree):
+        # an on-disk valid/ root wins over valid_fraction (image_folder)
+        from PIL import Image
+        rng = np.random.RandomState(9)
+        for cls in ("cat", "dog"):
+            d = tree / "valid" / cls
+            d.mkdir(parents=True)
+            for i in range(2):
+                arr = rng.randint(0, 255, (48, 40, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.jpg")
+        cfg = Config(
+            task=TaskConfig(task="image_folder", data_dir=str(tree),
+                            batch_size=4, image_size_override=32,
+                            valid_fraction=0.5),
+            device=DeviceConfig(num_replicas=1, seed=0))
+        bundle = get_loader(cfg)
+        assert bundle.num_valid_samples == 4
+        assert bundle.num_train_samples == 12      # train untouched
+        batch = next(bundle.valid_loader)
+        np.testing.assert_array_equal(batch["view1"], batch["view2"])
+
+    def test_valid_fraction_carves_image_folder(self, tree):
+        cfg = Config(
+            task=TaskConfig(task="image_folder", data_dir=str(tree),
+                            batch_size=4, image_size_override=32,
+                            valid_fraction=0.25),
+            device=DeviceConfig(num_replicas=1, seed=0))
+        bundle = get_loader(cfg)
+        assert bundle.num_valid_samples == 3       # 12 * 0.25
+        assert bundle.num_train_samples == 9
 
     def test_missing_root_raises(self, tmp_path):
         cfg = Config(task=TaskConfig(task="image_folder",

@@ -50,3 +50,16 @@ def test_two_process_train_step():
         losses.append(float(m.group(1)))
     # SPMD: both ranks computed the same global loss
     assert losses[0] == losses[1]
+
+    # multi-host offline linear eval: both ranks extracted the same global
+    # feature matrix (per-host shards gathered over the mesh) and fit the
+    # identical probe — top1 and the de-duplicated counts must agree
+    evals = []
+    for out in outs:
+        m = re.search(r"LE top1=(-?\d+\.\d+) ntrain=(\d+) ntest=(\d+)", out)
+        assert m, out[-2000:]
+        evals.append((float(m.group(1)), int(m.group(2)), int(m.group(3))))
+    assert evals[0] == evals[1]
+    # the TRAIN features span both hosts' shards (8 + 8) and the replicated
+    # test set was kept once, not twice (Quirk Q9 de-dup)
+    assert evals[0][1] == 16 and evals[0][2] == 4

@@ -82,6 +82,29 @@ def test_fit_resume_continues_epochs(tmp_path):
 
 
 @pytest.mark.slow
+def test_fit_with_valid_split(tmp_path):
+    """--valid-fraction: the held-out split is evaluated and logged each
+    epoch (num_valid_samples contract, reference main.py:421-423)."""
+    cfg = _tiny_cfg(tmp_path,
+                    task=TaskConfig(task="fake", batch_size=16, epochs=1,
+                                    image_size_override=16,
+                                    valid_fraction=0.25,
+                                    log_dir=str(tmp_path / "runs")),
+                    device=DeviceConfig(num_replicas=8, half=False, seed=7,
+                                        debug_step=True))
+    grapher = Grapher("jsonl", logdir=str(tmp_path / "runs"), run_name="v",
+                      enabled=True)
+    loader = _tiny_loader(cfg)
+    assert loader.num_valid_samples == 8 and loader.num_train_samples == 24
+    result = fit(cfg, loader=loader, grapher=grapher, verbose=False)
+    assert np.isfinite(result.test_metrics["loss_mean"])
+    keys = set()
+    for l in open(tmp_path / "runs" / "v" / "metrics.jsonl"):
+        keys.update(json.loads(l))
+    assert "valid_loss_mean" in keys
+
+
+@pytest.mark.slow
 def test_fit_debug_step(tmp_path):
     cfg = _tiny_cfg(tmp_path,
                     device=DeviceConfig(num_replicas=8, half=False, seed=7,
