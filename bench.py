@@ -446,6 +446,14 @@ def main():
     baseline = best_throughput("reference_faithful", half=False,
                                fuse_views=False,
                                ema_update_mode="reference_pre", steps=10)
+    # Middle rung: reference SEMANTICS (four forwards, pre-update EMA) at
+    # bf16.  Separates what dtype buys from what the redesign buys:
+    #   vs_baseline      = tpu_first / fp32-reference   (total win)
+    #   bf16_ref/baseline = dtype alone
+    #   tpu_first/bf16_ref = redesign alone (fuse_views + post-EMA)
+    bf16_ref = best_throughput("reference_semantics_bf16", half=True,
+                               fuse_views=False,
+                               ema_update_mode="reference_pre", steps=10)
     if value is None:
         if _backend_dead:
             raise RuntimeError(
@@ -457,14 +465,20 @@ def main():
             f"per-candidate tracebacks above, partial log in {_PARTIAL_PATH}")
 
     mfu = mfu_of(value)
-    print(json.dumps({
+    out = {
         "metric": f"{arch}_byol_train_images_per_sec_per_chip",
         "value": round(value, 2),
         "unit": "images/sec/chip",
         "vs_baseline": (round(value / baseline, 3)
                         if baseline is not None else None),
         "mfu": round(mfu, 4) if mfu is not None else None,
-    }))
+    }
+    if bf16_ref is not None:
+        out["bf16_reference_semantics"] = round(bf16_ref, 2)
+        if baseline is not None:
+            out["dtype_gain"] = round(bf16_ref / baseline, 3)
+        out["redesign_gain"] = round(value / bf16_ref, 3)
+    print(json.dumps(out))
 
 
 def _profile(arch, image_size, candidates, logdir):
@@ -553,6 +567,9 @@ def _data_pipeline_bench():
     if "native" not in rates:
         print("bench: native C++ backend unavailable (no toolchain/.so); "
               "reporting tf only", file=sys.stderr)
+
+    jpeg_rates = _jpeg_tree_bench()
+
     primary = rates.get("native", rates["tf"])
     print(json.dumps({
         "metric": "host_data_pipeline_images_per_sec",
@@ -561,7 +578,83 @@ def _data_pipeline_bench():
         "vs_baseline": (round(rates["native"] / rates["tf"], 3)
                         if "native" in rates else None),
         "note": "two-view augmented batches; vs_baseline = native/tf",
+        "jpeg_224": jpeg_rates,
     }))
+
+
+def _jpeg_tree_bench():
+    """224px fused-JPEG-decode ladder over an on-disk ImageFolder tree —
+    the configuration the DALI analog exists for (reference main.py:356-382
+    serves ImageNet JPEG trees).  Synthetic ~500x375 JPEGs with smooth
+    content so compression ratio and decode cost look like photographs,
+    not noise.  Reports img/s per host for the tf fused-decode path and the
+    native libjpeg fused decode+crop path, plus the per-core rate (this box
+    has few cores; TPU pod hosts have 100+ — the per-core number is what
+    scales)."""
+    import os
+    import shutil
+    import tempfile
+
+    from byol_tpu.core.config import Config, DeviceConfig, TaskConfig
+    from byol_tpu.data import native_aug
+    from byol_tpu.data.loader import get_loader
+
+    try:
+        from PIL import Image
+    except ImportError:
+        print("bench: PIL unavailable; skipping jpeg_224 stage",
+              file=sys.stderr)
+        return None
+
+    root = tempfile.mkdtemp(prefix="byol_jpeg_bench_")
+    rng = np.random.RandomState(0)
+    n_imgs, hw = 256, (375, 500)
+    try:
+        for split, n in (("train", n_imgs), ("test", 8)):
+            for cls in ("a", "b"):
+                d = os.path.join(root, split, cls)
+                os.makedirs(d)
+                for i in range(n // 2):
+                    # low-frequency content: upsampled 12x16 noise ->
+                    # photograph-like JPEG entropy (~100 KB at q87)
+                    low = rng.randint(0, 255, (12, 16, 3), np.uint8)
+                    img = Image.fromarray(low).resize(
+                        (hw[1], hw[0]), Image.BILINEAR)
+                    img.save(os.path.join(d, f"{i}.jpg"), quality=87)
+        backends = ["tf"] + (["native"] if native_aug.available()
+                             and native_aug.has_jpeg() else [])
+        out = {}
+        bs = 64
+        for backend in backends:
+            cfg = Config(
+                task=TaskConfig(task="image_folder", data_dir=root,
+                                batch_size=bs, epochs=1,
+                                image_size_override=224,
+                                data_backend=backend),
+                device=DeviceConfig(num_replicas=1, seed=0,
+                                    workers_per_replica=min(
+                                        os.cpu_count() or 1, 16)))
+            bundle = get_loader(cfg)
+            for _ in bundle.train_loader:      # warm: tf graph/thread pools
+                pass
+            t0 = time.perf_counter()
+            batches = 0
+            for e in range(2):
+                bundle.set_all_epochs(e)
+                for _ in bundle.train_loader:
+                    batches += 1
+            dt = time.perf_counter() - t0
+            rate = bs * batches / dt
+            out[backend] = round(rate, 1)
+            print(f"bench: jpeg_224 backend {backend}: {rate:.1f} img/s "
+                  f"({rate / (os.cpu_count() or 1):.1f} img/s/core, "
+                  f"{batches} two-view batches)", file=sys.stderr)
+        out["cores"] = os.cpu_count() or 1
+        out["note"] = ("fused decode+crop, two 224px views/img; scale by "
+                       "host cores vs the chip's img/s consumption")
+        return out
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def _sweep_prior_rows() -> dict:

@@ -75,8 +75,14 @@ def _is_jpeg(path):
 
 
 def image_folder_loader(cfg: Config, *, host_batch: int,
-                        shard_eval: bool = False):
-    """Build a LoaderBundle over train/ and test/ ImageFolder roots."""
+                        shard_eval: bool = False, backend: str = "tf"):
+    """Build a LoaderBundle over train/ and test/ ImageFolder roots.
+
+    ``backend='tf'``: tf.data with fused ``decode_and_crop_jpeg``.
+    ``backend='native'``: the first-party C++ pipeline (data/native/) with
+    libjpeg fused decode+crop — the DALI-equivalent that owns the whole
+    decode→augment hot path without TF dispatch (reference main.py:356-382).
+    """
     import jax
     import tensorflow as tf
 
@@ -127,8 +133,93 @@ def image_folder_loader(cfg: Config, *, host_batch: int,
     va_sh = shard(va_paths, va_labels)
     te_sh = shard(te_paths, te_labels) if shard_eval else (te_paths, te_labels)
 
+    def make_native_iter(paths, labels, train: bool
+                         ) -> Callable[[int], Iterator[dict]]:
+        """C++ fused-JPEG pipeline iterator: threaded file reads, one
+        native call per batch (decode window + augment in C++ threads), a
+        depth-2 background prefetcher so host augment overlaps the train
+        step.  Same contract as the tf.data path: per-epoch reshuffle from
+        (seed, epoch), drop-remainder train batching, resize-only eval."""
+        import concurrent.futures
+        import queue as queue_lib
+        import threading
+
+        from byol_tpu.data import native_aug
+
+        paths_t = np.asarray(paths)
+        labels_t = np.asarray(labels, np.int32)
+        workers = max(cfg.device.workers_per_replica, 1)
+
+        def produce(epoch: int):
+            idx = np.arange(len(labels_t))
+            if train:
+                np.random.RandomState(seed + epoch).shuffle(idx)
+            n = len(idx)
+            end = n - (n % host_batch) if train else n
+            with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+                for lo in range(0, end, host_batch):
+                    take = idx[lo:lo + host_batch]
+                    blobs = list(pool.map(
+                        lambda p: open(p, "rb").read(), paths_t[take]))
+                    if train:
+                        v1, v2 = native_aug.jpeg_augment_two_views(
+                            blobs, size, color_jitter_strength=cj,
+                            seed=seed + 1_000_003 * epoch,
+                            index_base=int(lo), num_threads=workers)
+                    else:
+                        v1 = native_aug.jpeg_resize_batch(
+                            blobs, size, num_threads=workers)
+                        v2 = v1
+                    yield {"view1": v1, "view2": v2,
+                           "label": labels_t[take]}
+
+        def make(epoch: int) -> Iterator[dict]:
+            q: queue_lib.Queue = queue_lib.Queue(maxsize=2)
+            DONE = object()
+            stop = threading.Event()   # consumer abandoned the iterator
+
+            def _put(item) -> bool:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        return True
+                    except queue_lib.Full:
+                        continue
+                return False
+
+            def worker():
+                gen = produce(epoch)
+                try:
+                    for item in gen:
+                        if not _put(item):
+                            return       # abandoned: stop producing
+                    _put(DONE)
+                except BaseException as e:   # surface errors, don't hang
+                    _put(e)
+                finally:
+                    gen.close()          # closes the read thread pool
+
+            threading.Thread(target=worker, daemon=True).start()
+            try:
+                while True:
+                    item = q.get()
+                    if item is DONE:
+                        return
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item
+            finally:
+                # break early / GeneratorExit: release the producer thread
+                # and its thread pool instead of leaking them blocked on a
+                # full queue (each leak pins workers + two buffered batches)
+                stop.set()
+
+        return make
+
     def make_iter(paths, labels, train: bool
                   ) -> Callable[[int], Iterator[dict]]:
+        if backend == "native":
+            return make_native_iter(paths, labels, train)
         paths_t = np.asarray(paths)
         labels_t = np.asarray(labels, np.int32)
 

@@ -285,12 +285,6 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
                          f"by process count {count}")
     host_batch = cfg.task.batch_size // count
 
-    if task == "image_folder":
-        # tf.data fused-decode path; supports every aug_spec
-        from byol_tpu.data.imagefolder import image_folder_loader
-        return image_folder_loader(cfg, host_batch=host_batch,
-                                   shard_eval=shard_eval)
-
     # Resolve the effective backend and validate the aug spec BEFORE any
     # dataset download/load, so a bad combination fails fast.
     backend = cfg.task.data_backend
@@ -304,10 +298,23 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
             print("byol_tpu: native data backend unavailable "
                   "(no g++/.so); falling back to tf.data")
             backend = "tf"
+        elif task == "image_folder" and not native_aug.has_jpeg():
+            print("byol_tpu: native backend built without libjpeg; "
+                  "image_folder falls back to tf.data fused decode")
+            backend = "tf"
     if cfg.regularizer.aug_spec != "reference" and backend != "tf":
         raise ValueError(
             f"aug_spec={cfg.regularizer.aug_spec!r} is implemented on the "
             f"tf data backend only (got data_backend={backend!r})")
+
+    if task == "image_folder":
+        if backend == "device":
+            raise ValueError(
+                "data_backend='device' does not serve image_folder (decode "
+                "is inherently host-side); use 'tf' or 'native'")
+        from byol_tpu.data.imagefolder import image_folder_loader
+        return image_folder_loader(cfg, host_batch=host_batch,
+                                   shard_eval=shard_eval, backend=backend)
 
     if task == "fake":
         size = cfg.task.image_size_override or 32

@@ -24,9 +24,24 @@
 // reproducible and views are decorrelated — same contract as the stateless
 // TF path.
 //
+// JPEG path (BYOL_WITH_JPEG): the reference's DALI exists precisely for
+// host-bound JPEG decode+augment at ImageNet scale (main.py:356-382,
+// README.md:90-93).  Equivalent trick here, via libjpeg-turbo:
+//   1. read ONLY the header for (h, w);
+//   2. sample the RandomResizedCrop window in full-image coordinates;
+//   3. decode ONLY that window — DCT-domain scaling (scale_num/8 chosen so
+//      the decoded crop is ~>= the target size) + jpeg_crop_scanline column
+//      cropping + jpeg_skip_scanlines row skipping, then abort the rest;
+//   4. bilinear-resize the decoded window to (size, size) and run the same
+//      post-crop augment chain as the array path (same PRNG draw order).
+// This is the fused decode+crop DALI/tf.image.decode_and_crop_jpeg do; the
+// DCT scaling trades a slight low-pass for O(crop*scale^2) work instead of
+// O(image) — the standard ImageNet-pipeline tradeoff.
+//
 // Build: g++ -O3 -shared -fPIC -pthread -o libbyol_aug.so image_pipeline.cpp
-// (byol_tpu/data/native.py compiles this lazily and falls back to the
-// tf.data path if no toolchain is present).
+// [-DBYOL_WITH_JPEG -ljpeg]
+// (byol_tpu/data/native_aug.py compiles this lazily — first with libjpeg,
+// falling back to no-JPEG, then to the tf.data path if no toolchain).
 
 #include <algorithm>
 #include <atomic>
@@ -36,6 +51,12 @@
 #include <functional>
 #include <thread>
 #include <vector>
+
+#ifdef BYOL_WITH_JPEG
+#include <csetjmp>
+#include <cstdio>
+#include <jpeglib.h>
+#endif
 
 namespace {
 
@@ -121,18 +142,11 @@ inline float gray_of(const float* px) {
   return 0.2989f * px[0] + 0.587f * px[1] + 0.114f * px[2];
 }
 
-// one augmented view: src uint8 (h, w, 3) -> dst float32 (size, size, 3)
-void augment_one(const uint8_t* src, int h, int w, float* dst, int size,
-                 float cj_strength, Rng& rng) {
-  // 1) RandomResizedCrop (bilinear)
-  CropWindow win = sample_crop(rng, h, w);
-  double step_y = win.ch / size, step_x = win.cw / size;
-  for (int y = 0; y < size; ++y) {
-    for (int x = 0; x < size; ++x) {
-      bilinear_rgb(src, h, w, win.y0 + (y + 0.5) * step_y - 0.5,
-                   win.x0 + (x + 0.5) * step_x - 0.5, dst + (y * size + x) * 3);
-    }
-  }
+// steps 2-5 of one augmented view, applied in-place to the cropped+resized
+// float32 (size, size, 3) buffer.  ONE implementation shared by the
+// uint8-array and JPEG paths so both draw from the PRNG in the same order
+// (crop draws happen in sample_crop before this is called).
+void post_crop_augment(float* dst, int size, float cj_strength, Rng& rng) {
   const int n = size * size;
 
   // 2) HFlip p=.5
@@ -257,6 +271,21 @@ void augment_one(const uint8_t* src, int h, int w, float* dst, int size,
   }
 }
 
+// one augmented view: src uint8 (h, w, 3) -> dst float32 (size, size, 3)
+void augment_one(const uint8_t* src, int h, int w, float* dst, int size,
+                 float cj_strength, Rng& rng) {
+  // 1) RandomResizedCrop (bilinear)
+  CropWindow win = sample_crop(rng, h, w);
+  double step_y = win.ch / size, step_x = win.cw / size;
+  for (int y = 0; y < size; ++y) {
+    for (int x = 0; x < size; ++x) {
+      bilinear_rgb(src, h, w, win.y0 + (y + 0.5) * step_y - 0.5,
+                   win.x0 + (x + 0.5) * step_x - 0.5, dst + (y * size + x) * 3);
+    }
+  }
+  post_crop_augment(dst, size, cj_strength, rng);
+}
+
 // test-only resize (bilinear, whole image -> size x size), matching the
 // reference's Resize-only eval transform (main.py:398)
 void resize_one(const uint8_t* src, int h, int w, float* dst, int size) {
@@ -267,6 +296,168 @@ void resize_one(const uint8_t* src, int h, int w, float* dst, int size) {
       bilinear_rgb(src, h, w, (y + 0.5) * step_y - 0.5,
                    (x + 0.5) * step_x - 0.5, dst + (y * size + x) * 3);
 }
+
+#ifdef BYOL_WITH_JPEG
+// ---- libjpeg(-turbo) fused decode ----------------------------------------
+struct JpegErrorMgr {
+  jpeg_error_mgr mgr;
+  jmp_buf setjmp_buffer;
+};
+
+void jpeg_error_exit(j_common_ptr cinfo) {
+  JpegErrorMgr* err = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+void jpeg_silent(j_common_ptr, int) {}
+void jpeg_silent_msg(j_common_ptr) {}
+
+// RAII so longjmp error paths can't leak the decompress object
+struct JpegDecoder {
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  bool live = false;
+  JpegDecoder() {
+    cinfo.err = jpeg_std_error(&jerr.mgr);
+    jerr.mgr.error_exit = jpeg_error_exit;
+    jerr.mgr.emit_message = jpeg_silent;
+    jerr.mgr.output_message = jpeg_silent_msg;
+    jpeg_create_decompress(&cinfo);
+    live = true;
+  }
+  ~JpegDecoder() {
+    if (live) jpeg_destroy_decompress(&cinfo);
+  }
+};
+
+// Decode a rectangular window of a JPEG at DCT scale s/8.
+//   win (fractional, FULL-RES coords) -> decoded uint8 RGB buffer `out`
+//   covering at least the window at scale s/8; returns false on corrupt /
+//   unsupported (CMYK etc.) input.  `bw/bh` = buffer dims; `by0/bx0` =
+//   buffer origin in SCALED image coords.
+bool jpeg_decode_window(const uint8_t* data, size_t len, const CropWindow& win,
+                        int scale_num, std::vector<uint8_t>& out, int* bw,
+                        int* bh, double* by0, double* bx0, double* sy_scale,
+                        double* sx_scale) {
+  JpegDecoder dec;
+  jpeg_decompress_struct& cinfo = dec.cinfo;
+  if (setjmp(dec.jerr.setjmp_buffer)) return false;
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) return false;
+  cinfo.out_color_space = JCS_RGB;
+  cinfo.scale_num = scale_num;
+  cinfo.scale_denom = 8;
+  cinfo.dct_method = JDCT_ISLOW;
+  if (!jpeg_start_decompress(&cinfo)) return false;
+  if (cinfo.output_components != 3) return false;  // CMYK etc.: caller falls back
+  const int ow = cinfo.output_width, oh = cinfo.output_height;
+  // full-res fractional window -> scaled coords (libjpeg scales by the
+  // EXACT rational output_size/input_size, matching these factors)
+  const double fy = static_cast<double>(oh) / cinfo.image_height;
+  const double fx = static_cast<double>(ow) / cinfo.image_width;
+  double y0s = win.y0 * fy, x0s = win.x0 * fx;
+  double chs = win.ch * fy, cws = win.cw * fx;
+  int y_lo = std::max(0, static_cast<int>(std::floor(y0s)));
+  int y_hi = std::min(oh, static_cast<int>(std::ceil(y0s + chs)) + 1);
+  JDIMENSION xoff = static_cast<JDIMENSION>(
+      std::max(0, static_cast<int>(std::floor(x0s))));
+  JDIMENSION xw = static_cast<JDIMENSION>(
+      std::min(ow - static_cast<int>(xoff),
+               static_cast<int>(std::ceil(cws)) + 2));
+  // jpeg_crop_scanline rounds xoff DOWN to an iMCU boundary and widens xw
+  // accordingly; it returns the adjusted values.
+  jpeg_crop_scanline(&cinfo, &xoff, &xw);
+  if (y_hi <= y_lo) y_hi = std::min(oh, y_lo + 1);
+  out.resize(static_cast<size_t>(y_hi - y_lo) * xw * 3);
+  if (y_lo > 0) jpeg_skip_scanlines(&cinfo, y_lo);
+  JSAMPROW row;
+  for (int y = y_lo; y < y_hi; ++y) {
+    row = out.data() + static_cast<size_t>(y - y_lo) * xw * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_abort_decompress(&cinfo);  // skip the remaining rows entirely
+  *bw = static_cast<int>(xw);
+  *bh = y_hi - y_lo;
+  *by0 = y_lo;
+  *bx0 = xoff;
+  *sy_scale = fy;
+  *sx_scale = fx;
+  return true;
+}
+
+// pick the smallest DCT scale s/8 whose decoded window still has >= `size`
+// pixels on its short side (never upscale past full resolution)
+int pick_scale(double win_short, int size) {
+  for (int s = 1; s <= 8; ++s) {
+    if (win_short * s / 8.0 >= size) return s;
+  }
+  return 8;
+}
+
+// one augmented view straight from JPEG bytes; false -> caller must fall
+// back (corrupt file / CMYK / not a JPEG)
+bool jpeg_augment_one(const uint8_t* data, size_t len, float* dst, int size,
+                      float cj_strength, Rng& rng) {
+  // header-only pass for dimensions (cheap: no IDCT)
+  int h, w;
+  {
+    JpegDecoder dec;
+    if (setjmp(dec.jerr.setjmp_buffer)) return false;
+    jpeg_mem_src(&dec.cinfo, const_cast<uint8_t*>(data),
+                 static_cast<unsigned long>(len));
+    if (jpeg_read_header(&dec.cinfo, TRUE) != JPEG_HEADER_OK) return false;
+    h = dec.cinfo.image_height;
+    w = dec.cinfo.image_width;
+  }
+  if (h <= 0 || w <= 0) return false;
+  // 1) sample the crop in full-res coords (same draw order as the array
+  // path), then decode only that window
+  CropWindow win = sample_crop(rng, h, w);
+  int scale = pick_scale(std::min(win.ch, win.cw), size);
+  std::vector<uint8_t> buf;
+  int bw, bh;
+  double by0, bx0, fy, fx;
+  if (!jpeg_decode_window(data, len, win, scale, buf, &bw, &bh, &by0, &bx0,
+                          &fy, &fx))
+    return false;
+  // window in buffer coords
+  const double wy0 = win.y0 * fy - by0, wx0 = win.x0 * fx - bx0;
+  const double step_y = win.ch * fy / size, step_x = win.cw * fx / size;
+  for (int y = 0; y < size; ++y) {
+    for (int x = 0; x < size; ++x) {
+      bilinear_rgb(buf.data(), bh, bw, wy0 + (y + 0.5) * step_y - 0.5,
+                   wx0 + (x + 0.5) * step_x - 0.5, dst + (y * size + x) * 3);
+    }
+  }
+  post_crop_augment(dst, size, cj_strength, rng);
+  return true;
+}
+
+// eval: full-frame decode at the coarsest sufficient DCT scale + resize
+// (reference Resize-only test transform, main.py:398)
+bool jpeg_resize_one(const uint8_t* data, size_t len, float* dst, int size) {
+  int h, w;
+  {
+    JpegDecoder dec;
+    if (setjmp(dec.jerr.setjmp_buffer)) return false;
+    jpeg_mem_src(&dec.cinfo, const_cast<uint8_t*>(data),
+                 static_cast<unsigned long>(len));
+    if (jpeg_read_header(&dec.cinfo, TRUE) != JPEG_HEADER_OK) return false;
+    h = dec.cinfo.image_height;
+    w = dec.cinfo.image_width;
+  }
+  CropWindow full{0.0, 0.0, static_cast<double>(h), static_cast<double>(w)};
+  int scale = pick_scale(std::min(h, w), size);
+  std::vector<uint8_t> buf;
+  int bw, bh;
+  double by0, bx0, fy, fx;
+  if (!jpeg_decode_window(data, len, full, scale, buf, &bw, &bh, &by0, &bx0,
+                          &fy, &fx))
+    return false;
+  resize_one(buf.data(), bh, bw, dst, size);
+  return true;
+}
+#endif  // BYOL_WITH_JPEG
 
 void run_threads(int n, int num_threads, const std::function<void(int)>& fn) {
   if (num_threads <= 1) {
@@ -316,5 +507,59 @@ void byol_resize_batch(const uint8_t* images, int n, int h, int w, float* out,
               [&](int i) { resize_one(images + i * in_stride, h, w,
                                       out + i * out_stride, size); });
 }
+
+// 1 when this build fuses JPEG decode (libjpeg linked), else 0 — lets the
+// Python side route image trees to tf.data when the toolchain lacked jpeg.
+int byol_has_jpeg(void) {
+#ifdef BYOL_WITH_JPEG
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+#ifdef BYOL_WITH_JPEG
+// Two augmented views per JPEG, fused decode+crop (the DALI-analog entry
+// point for image trees).  blob = concatenated JPEG byte streams;
+// offsets/sizes (n) delimit them.  ok[i]=0 flags images this decoder can't
+// serve (corrupt / CMYK / non-JPEG) — their outputs are zeroed and the
+// caller re-decodes those few via its fallback path.
+void byol_jpeg_augment_two_views(const uint8_t* blob, const uint64_t* offsets,
+                                 const uint64_t* sizes, int n, float* out1,
+                                 float* out2, int size, float cj_strength,
+                                 uint64_t seed, uint64_t index_base,
+                                 int num_threads, int32_t* ok) {
+  const size_t out_stride = static_cast<size_t>(size) * size * 3;
+  run_threads(n, num_threads, [&](int i) {
+    const uint8_t* data = blob + offsets[i];
+    const size_t len = sizes[i];
+    uint64_t base = seed * 0x9e3779b97f4a7c15ULL + (index_base + i);
+    Rng r1(base * 2 + 0), r2(base * 2 + 1);
+    bool ok1 = jpeg_augment_one(data, len, out1 + i * out_stride, size,
+                                cj_strength, r1);
+    bool ok2 = ok1 && jpeg_augment_one(data, len, out2 + i * out_stride, size,
+                                       cj_strength, r2);
+    ok[i] = (ok1 && ok2) ? 1 : 0;
+    if (!ok[i]) {
+      std::memset(out1 + i * out_stride, 0, out_stride * sizeof(float));
+      std::memset(out2 + i * out_stride, 0, out_stride * sizeof(float));
+    }
+  });
+}
+
+// Resize-only eval batch from JPEG bytes.
+void byol_jpeg_resize_batch(const uint8_t* blob, const uint64_t* offsets,
+                            const uint64_t* sizes, int n, float* out, int size,
+                            int num_threads, int32_t* ok) {
+  const size_t out_stride = static_cast<size_t>(size) * size * 3;
+  run_threads(n, num_threads, [&](int i) {
+    ok[i] = jpeg_resize_one(blob + offsets[i], sizes[i], out + i * out_stride,
+                            size)
+                ? 1
+                : 0;
+    if (!ok[i]) std::memset(out + i * out_stride, 0, out_stride * sizeof(float));
+  });
+}
+#endif  // BYOL_WITH_JPEG
 
 }  // extern "C"

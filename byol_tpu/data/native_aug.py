@@ -29,9 +29,16 @@ _build_error: Optional[str] = None
 
 
 def _build() -> None:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
-           "-o", _LIB, _SRC]
-    proc = subprocess.run(cmd, capture_output=True, text=True)
+    base = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+            "-o", _LIB, _SRC]
+    # Prefer the JPEG-fused build (libjpeg-turbo: fused decode+crop, the
+    # DALI analog for image trees); fall back to the array-only build when
+    # the system lacks jpeglib.h / -ljpeg.
+    proc = subprocess.run(base + ["-DBYOL_WITH_JPEG", "-ljpeg"],
+                          capture_output=True, text=True)
+    if proc.returncode == 0:
+        return
+    proc = subprocess.run(base, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(f"native build failed: {proc.stderr[-2000:]}")
 
@@ -60,6 +67,20 @@ def load(rebuild: bool = False) -> ctypes.CDLL:
                 u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                 f32p, ctypes.c_int, ctypes.c_int]
             lib.byol_resize_batch.restype = None
+            lib.byol_has_jpeg.argtypes = []
+            lib.byol_has_jpeg.restype = ctypes.c_int
+            if lib.byol_has_jpeg():
+                u64p = ctypes.POINTER(ctypes.c_uint64)
+                i32p = ctypes.POINTER(ctypes.c_int32)
+                lib.byol_jpeg_augment_two_views.argtypes = [
+                    u8p, u64p, u64p, ctypes.c_int, f32p, f32p,
+                    ctypes.c_int, ctypes.c_float, ctypes.c_uint64,
+                    ctypes.c_uint64, ctypes.c_int, i32p]
+                lib.byol_jpeg_augment_two_views.restype = None
+                lib.byol_jpeg_resize_batch.argtypes = [
+                    u8p, u64p, u64p, ctypes.c_int, f32p, ctypes.c_int,
+                    ctypes.c_int, i32p]
+                lib.byol_jpeg_resize_batch.restype = None
             _lib = lib
             _build_error = None
             return lib
@@ -117,4 +138,104 @@ def resize_batch(images: np.ndarray, size: int, *,
         images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n, h, w,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size,
         num_threads)
+    return out
+
+
+# ---- fused JPEG decode (the DALI-analog path for image trees) -------------
+
+def has_jpeg() -> bool:
+    """True when the loaded binary links libjpeg (fused decode available)."""
+    try:
+        return bool(load().byol_has_jpeg())
+    except Exception:
+        return False
+
+
+def _pack_blobs(blobs) -> tuple:
+    sizes = np.array([len(b) for b in blobs], np.uint64)
+    offsets = np.zeros(len(blobs), np.uint64)
+    np.cumsum(sizes[:-1], out=offsets[1:])
+    blob = np.frombuffer(b"".join(blobs), np.uint8)
+    return blob, offsets, sizes
+
+
+def _decode_fallback(data: bytes) -> Optional[np.ndarray]:
+    """PIL decode for the rare file the C++ path flags (non-JPEG extension
+    lying about its content, CMYK, corrupt-but-PIL-tolerant)."""
+    import io
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    except Exception:
+        return None
+
+
+def jpeg_augment_two_views(blobs, size: int, *,
+                           color_jitter_strength: float = 1.0, seed: int = 0,
+                           index_base: int = 0,
+                           num_threads: Optional[int] = None
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+    """list of JPEG byte strings -> two (N, size, size, 3) float32 views.
+
+    Fused decode+crop per view in C++ (only the sampled RandomResizedCrop
+    window is decoded, DCT-scaled); files the native decoder rejects are
+    re-decoded via PIL and fed through the uint8-array augment path with
+    the SAME (seed, index, view) streams, so a mixed tree stays
+    deterministic."""
+    lib = load()
+    if not lib.byol_has_jpeg():
+        raise RuntimeError("native library built without libjpeg")
+    n = len(blobs)
+    if num_threads is None:
+        num_threads = min(os.cpu_count() or 1, 16)
+    blob, offsets, sizes = _pack_blobs(blobs)
+    v1 = np.empty((n, size, size, 3), np.float32)
+    v2 = np.empty((n, size, size, 3), np.float32)
+    ok = np.empty((n,), np.int32)
+    lib.byol_jpeg_augment_two_views(
+        blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        n, v1.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        v2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        size, float(color_jitter_strength), seed & (2**64 - 1),
+        index_base & (2**64 - 1), num_threads,
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    for i in np.nonzero(ok == 0)[0]:
+        img = _decode_fallback(blobs[i])
+        if img is None:
+            continue           # undecodable: keep the zeroed output
+        a, b = augment_two_views(img[None], size,
+                                 color_jitter_strength=color_jitter_strength,
+                                 seed=seed, index_base=index_base + int(i),
+                                 num_threads=1)
+        v1[i], v2[i] = a[0], b[0]
+    return v1, v2
+
+
+def jpeg_resize_batch(blobs, size: int, *,
+                      num_threads: Optional[int] = None) -> np.ndarray:
+    """list of JPEG byte strings -> (N, size, size, 3) float32, resize-only
+    (eval transform)."""
+    lib = load()
+    if not lib.byol_has_jpeg():
+        raise RuntimeError("native library built without libjpeg")
+    n = len(blobs)
+    if num_threads is None:
+        num_threads = min(os.cpu_count() or 1, 16)
+    blob, offsets, sizes = _pack_blobs(blobs)
+    out = np.empty((n, size, size, 3), np.float32)
+    ok = np.empty((n,), np.int32)
+    lib.byol_jpeg_resize_batch(
+        blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size,
+        num_threads,
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    for i in np.nonzero(ok == 0)[0]:
+        img = _decode_fallback(blobs[i])
+        if img is None:
+            continue
+        out[i] = resize_batch(img[None], size, num_threads=1)[0]
     return out

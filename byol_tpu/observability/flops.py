@@ -60,8 +60,14 @@ def cost_analysis_flops(jitted_fn, *args) -> Optional[float]:
           else getattr(jitted_fn, "__wrapped__", jitted_fn))
     try:
         analysis = fn.lower(*args).cost_analysis()
-        if isinstance(analysis, (list, tuple)):   # per-device variants
-            analysis = analysis[0]
+        if isinstance(analysis, (list, tuple)):
+            # Some jax versions return one dict per device here.  Whether
+            # those entries hold per-device or global FLOPs is
+            # version-dependent, and guessing wrong silently skews MFU by
+            # n_devices — disarm instead (mfu() treats None as unknown).
+            # The pinned version returns a plain dict with GLOBAL flops
+            # (tests/test_observability.py pins that accounting).
+            return None
         flops = float(analysis["flops"])
         return flops if flops > 0 else None
     except Exception:

@@ -1,0 +1,56 @@
+"""Cross-host lockstep iteration for SPMD eval loops.
+
+Per-host data shards can differ by one batch (interleaved image_folder
+shards, uneven valid splits).  Every eval step is an SPMD collective over
+the mesh, so a host that drains its shard early and simply exits its loop
+deadlocks the pod: the remaining hosts' next step blocks forever waiting
+for it.  (The reference never hits this class of bug only because its eval
+is replicated per rank, main.py:422 — the NCCL analog would be a rank
+skipping an allreduce.)
+
+The protocol here: each round, every host all-gathers one status int
+(0 = drained, 1 = has data); iteration continues while ANY host has data,
+with drained hosts feeding caller-supplied all-pad batches (validity mask
+0, so they contribute nothing to metrics).  Single-process runs skip the
+collective entirely.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterator, TypeVar
+
+import numpy as np
+
+T = TypeVar("T")
+
+
+def all_status(status: int) -> np.ndarray:
+    """All-gather one small status code per host; shape (process_count,)."""
+    import jax
+    if jax.process_count() == 1:
+        return np.asarray([status])
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(
+        np.asarray([status], np.int32))).reshape(-1)
+
+
+def lockstep_iter(batches: Iterator[T], pad_fn: Callable[[], T]
+                  ) -> Iterator[T]:
+    """Yield local batches in lockstep across hosts.
+
+    A host whose iterator drains early keeps yielding ``pad_fn()`` until
+    every host is drained, so all hosts run the same number of SPMD steps.
+    On a single process this is plain iteration (no collectives)."""
+    import jax
+    it = iter(batches)
+    single = jax.process_count() == 1
+    while True:
+        batch = next(it, None)
+        if single:
+            if batch is None:
+                return
+            yield batch
+            continue
+        statuses = all_status(1 if batch is not None else 0)
+        if not (statuses == 1).any():
+            return
+        yield batch if batch is not None else pad_fn()

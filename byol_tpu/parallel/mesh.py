@@ -73,13 +73,16 @@ def _slice_granules(devices: Sequence[jax.Device]) -> list:
     elsewhere (single slice, CPU) the process is the best available proxy
     for the ICI boundary.  Groups are ordered by key so every process
     builds the identical mesh."""
-    keys = sorted({getattr(d, "slice_index", None) if
-                   getattr(d, "slice_index", None) is not None
-                   else d.process_index for d in devices})
+    # Namespaced keys: a slice id must never collide with a process id if a
+    # device set ever mixes devices with and without slice_index.
+    def key(d):
+        s = getattr(d, "slice_index", None)
+        return ("slice", s) if s is not None else ("proc", d.process_index)
+
+    keys = sorted({key(d) for d in devices})
     by_key = {k: [] for k in keys}
     for d in devices:
-        k = getattr(d, "slice_index", None)
-        by_key[k if k is not None else d.process_index].append(d)
+        by_key[key(d)].append(d)
     return [by_key[k] for k in keys]
 
 
@@ -96,11 +99,15 @@ def build_mesh(spec: MeshSpec = MeshSpec(),
     (``data index = slice * per_slice_dp + position_within_slice``), with
     each slice's block containing only ICI-connected devices, so the
     backend decomposes a data-axis all-reduce into an in-slice ICI phase
-    and a small cross-slice DCN phase.  Device order is identical to
+    and a small cross-slice DCN phase.  The LOGICAL layout matches
     ``mesh_utils.create_hybrid_device_mesh([per_slice_dp, seq, model],
     dcn_mesh_shape=[dcn, 1, 1])`` with the two data factors merged into
     one named axis — merged so every P('data') annotation, collective,
     and FSDP rule in the framework works unchanged at multi-slice scale.
+    Within a granule, devices keep raw enumeration order (create_device_mesh
+    would additionally reorder for physical ICI topology; route granules
+    through it on real multi-slice hardware if in-slice collective
+    bandwidth profiles as a bottleneck).
 
     ``sequence``/``model`` axes never span slices (ring attention and TP
     collectives are latency-sensitive and must stay on ICI); this is

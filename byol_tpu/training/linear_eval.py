@@ -40,6 +40,7 @@ import numpy as np
 import optax
 
 from byol_tpu.objectives.metrics import topk_accuracy
+from byol_tpu.parallel.lockstep import all_status
 
 
 @dataclasses.dataclass
@@ -73,22 +74,6 @@ def extract_features(apply_fn: Callable, batches: Iterator[Dict[str, Any]],
         feats.append(f.astype(np.float32))
         labels.append(y)
     return np.concatenate(feats), np.concatenate(labels)
-
-
-def _lockstep_status(status: int) -> np.ndarray:
-    """All-gather one per-host status code (0=drained, 1=has data, 2=error).
-
-    Hosts' shard sizes can differ by one batch (interleaved image_folder
-    shards), so extraction iterates in lockstep until every host is drained
-    — a host that finished early keeps feeding all-pad batches rather than
-    deadlocking the collective.  The error code lets a host that CANNOT
-    continue (empty shard, no shape template) fail every peer in the same
-    round instead of leaving them blocked in the next collective."""
-    if jax.process_count() == 1:
-        return np.asarray([status])
-    from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(
-        np.asarray([status], np.int32))).reshape(-1)
 
 
 def encoder_extractor_spmd(net, state, mesh, *, half: bool = False
@@ -148,10 +133,14 @@ def extract_features_spmd(apply_fn, batches: Iterator[Dict[str, Any]], mesh,
                               jax.process_count())
     while True:
         batch = next(it, None)
+        # status codes: 0 = drained, 1 = has data, 2 = error (an empty
+        # shard with no shape template cannot even feed pad batches — fail
+        # every peer in the same round instead of deadlocking the next
+        # collective)
         status = 1 if batch is not None else 0
         if batch is None and template is None:
-            status = 2         # cannot even feed pad batches: no shape known
-        statuses = _lockstep_status(status)
+            status = 2
+        statuses = all_status(status)
         if (statuses == 2).any():
             raise ValueError(
                 f"eval extraction cannot proceed: host(s) "

@@ -127,9 +127,23 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     # share one compiled executable and shard cleanly on the data axis.
     host_eval_batch = rcfg.global_batch_size // jax.process_count()
 
+    def _all_pad_batch():
+        """Zero-row batch for a host that drained its eval shard early;
+        pad_batch fills it to the static shape with an all-zero mask."""
+        h, w, c = rcfg.input_shape
+        z = np.zeros((0, h, w, c), np.float32)
+        return {"view1": z, "view2": z, "label": np.zeros((0,), np.int32)}
+
     def run_eval(state, batches=None) -> MetricAccumulator:
         acc = MetricAccumulator()
-        for batch in (loader.test_loader if batches is None else batches):
+        src = loader.test_loader if batches is None else batches
+        if jax.process_count() > 1:
+            # hosts' eval shards can differ by one batch (interleaved
+            # image_folder shards): iterate in lockstep or the pod
+            # deadlocks in eval_step's collectives
+            from byol_tpu.parallel.lockstep import lockstep_iter
+            src = lockstep_iter(src, _all_pad_batch)
+        for batch in src:
             dev_batch = shard_batch_to_mesh(
                 pad_batch(batch, host_eval_batch), mesh)
             acc.update(eval_step(state, dev_batch))

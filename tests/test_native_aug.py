@@ -74,6 +74,164 @@ def test_loader_native_backend_end_to_end():
     np.testing.assert_array_equal(eb["view1"], eb["view2"])
 
 
+def _jpeg_bytes(arr, quality=95):
+    import io
+
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+jpeg_only = pytest.mark.skipif(not native_aug.has_jpeg(),
+                               reason="built without libjpeg")
+
+
+@jpeg_only
+class TestJpegFusedDecode:
+    """The libjpeg fused decode+crop path — the DALI-analog for image trees
+    (reference main.py:356-382, README.md:90-93)."""
+
+    def test_two_views_shape_range_determinism(self):
+        blobs = [_jpeg_bytes(img) for img in _imgs(n=6, h=64, w=80)]
+        a1, a2 = native_aug.jpeg_augment_two_views(blobs, 32, seed=5)
+        assert a1.shape == a2.shape == (6, 32, 32, 3)
+        for v in (a1, a2):
+            assert v.min() >= 0.0 and v.max() <= 1.0
+        assert not np.allclose(a1, a2)           # independent view streams
+        b1, b2 = native_aug.jpeg_augment_two_views(blobs, 32, seed=5)
+        np.testing.assert_array_equal(a1, b1)    # deterministic
+        np.testing.assert_array_equal(a2, b2)
+
+    def test_multithreaded_matches_single_thread(self):
+        blobs = [_jpeg_bytes(img) for img in _imgs(n=12, h=50, w=60)]
+        s1, s2 = native_aug.jpeg_augment_two_views(blobs, 24, seed=3,
+                                                   num_threads=1)
+        m1, m2 = native_aug.jpeg_augment_two_views(blobs, 24, seed=3,
+                                                   num_threads=8)
+        np.testing.assert_array_equal(s1, m1)
+        np.testing.assert_array_equal(s2, m2)
+
+    def test_resize_matches_array_path_at_full_scale(self):
+        """When no DCT scaling kicks in (target ~ source size), the fused
+        path must reproduce the decode-then-resize reference exactly: the
+        same bilinear kernel runs over the same libjpeg-decoded pixels."""
+        import io
+
+        from PIL import Image
+        arr = _imgs(n=1, h=64, w=64)[0]
+        blob = _jpeg_bytes(arr)
+        decoded = np.asarray(Image.open(io.BytesIO(blob)).convert("RGB"))
+        fused = native_aug.jpeg_resize_batch([blob], 60)
+        oracle = native_aug.resize_batch(decoded[None], 60)
+        np.testing.assert_allclose(fused, oracle, atol=1e-6)
+
+    def test_dct_scaled_resize_close_to_full_decode(self):
+        """With DCT scaling active (small target), the result is a slightly
+        low-passed version of the full-res pipeline — close, not equal."""
+        import io
+
+        from PIL import Image
+        # smooth gradient image: scaling artifacts stay tiny
+        g = np.linspace(0, 255, 128, dtype=np.uint8)
+        arr = np.stack(np.broadcast_arrays(g[:, None], g[None, :],
+                                           g[:, None]), -1)
+        blob = _jpeg_bytes(np.ascontiguousarray(arr), quality=98)
+        decoded = np.asarray(Image.open(io.BytesIO(blob)).convert("RGB"))
+        fused = native_aug.jpeg_resize_batch([blob], 32)     # scale 2/8
+        oracle = native_aug.resize_batch(decoded[None], 32)
+        assert np.abs(fused - oracle).mean() < 0.02
+
+    def test_crop_window_statistics_match_array_path(self):
+        """Same (seed, index, view) streams drive both paths, so the crop
+        windows and post-crop draws coincide; only decoded pixel values may
+        differ (DCT scaling).  On a flat image the outputs must agree."""
+        arr = np.full((96, 96, 3), 128, np.uint8)
+        blob = _jpeg_bytes(arr, quality=100)
+        j1, j2 = native_aug.jpeg_augment_two_views([blob], 32, seed=9,
+                                                   index_base=4)
+        a1, a2 = native_aug.augment_two_views(arr[None], 32, seed=9,
+                                              index_base=4)
+        np.testing.assert_allclose(j1, a1, atol=0.02)
+        np.testing.assert_allclose(j2, a2, atol=0.02)
+
+    def test_non_jpeg_falls_back_to_pil(self):
+        import io
+
+        from PIL import Image
+        arr = _imgs(n=1, h=40, w=40)[0]
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        v1, v2 = native_aug.jpeg_augment_two_views(
+            [buf.getvalue()], 24, seed=2)
+        assert v1.max() > 0.0                    # fallback decoded something
+        # and the fallback is the SAME stream as the array path
+        a1, a2 = native_aug.augment_two_views(arr[None], 24, seed=2,
+                                              index_base=0)
+        np.testing.assert_array_equal(v1, a1)
+        np.testing.assert_array_equal(v2, a2)
+
+    def test_corrupt_jpeg_yields_zeros_not_crash(self):
+        good = _jpeg_bytes(_imgs(n=1)[0])
+        v1, _ = native_aug.jpeg_augment_two_views(
+            [b"\xff\xd8\xff\xe0garbage", good[:50], good], 16, seed=0)
+        assert v1[2].max() >= 0.0                # good image decoded
+        np.testing.assert_array_equal(v1[0], 0)  # corrupt -> zeroed
+        np.testing.assert_array_equal(v1[1], 0)
+
+    def test_image_folder_native_backend_loader(self, tmp_path):
+        from PIL import Image
+
+        from byol_tpu.core.config import (Config, DeviceConfig, TaskConfig)
+        from byol_tpu.data.loader import get_loader
+        rng = np.random.RandomState(0)
+        for split, n in (("train", 8), ("test", 4)):
+            for cls in ("a", "b"):
+                d = tmp_path / split / cls
+                d.mkdir(parents=True)
+                for i in range(n):
+                    arr = rng.randint(0, 255, (48, 56, 3), dtype=np.uint8)
+                    Image.fromarray(arr).save(d / f"{i}.jpg")
+        cfg = Config(task=TaskConfig(task="image_folder",
+                                     data_dir=str(tmp_path), batch_size=4,
+                                     image_size_override=32,
+                                     data_backend="native"),
+                     device=DeviceConfig(num_replicas=1, seed=3))
+        loader = get_loader(cfg)
+        assert loader.num_train_samples == 16
+        batches = list(loader.train_loader)
+        assert len(batches) == 4
+        b = batches[0]
+        assert b["view1"].shape == (4, 32, 32, 3)
+        assert 0.0 <= b["view1"].min() and b["view1"].max() <= 1.0
+        assert not np.allclose(b["view1"], b["view2"])
+        # determinism + epoch reseed (set_all_epochs contract)
+        again = next(iter(loader.train_loader))
+        np.testing.assert_array_equal(b["view1"], again["view1"])
+        loader.set_all_epochs(1)
+        b1 = next(iter(loader.train_loader))
+        assert not np.array_equal(b["view1"], b1["view1"])
+        # eval: resize-only, identical view slots
+        eb = next(iter(loader.test_loader))
+        np.testing.assert_array_equal(eb["view1"], eb["view2"])
+        # abandoning an iterator mid-epoch (debug_step / early break) must
+        # release the producer thread, not leak it blocked on the queue
+        import gc
+        import threading
+        import time as time_lib
+        before = threading.active_count()
+        it = iter(loader.train_loader)
+        next(it)
+        it.close()
+        del it
+        gc.collect()
+        for _ in range(50):                      # producer exits within 5s
+            if threading.active_count() <= before:
+                break
+            time_lib.sleep(0.1)
+        assert threading.active_count() <= before
+
+
 def test_augment_distribution_sanity():
     """Statistical smoke: over many samples, ~50% flips/blurs, ~20%
     grayscale.  Catches gate/draw seed-coupling regressions (the bug class
