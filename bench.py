@@ -568,7 +568,12 @@ def _data_pipeline_bench():
         print("bench: native C++ backend unavailable (no toolchain/.so); "
               "reporting tf only", file=sys.stderr)
 
-    jpeg_rates = _jpeg_tree_bench()
+    try:
+        jpeg_rates = _jpeg_tree_bench()
+    except Exception as e:     # degrade, never discard the measured rates
+        print(f"bench: jpeg_224 stage failed ({e!r}); array rates stand",
+              file=sys.stderr)
+        jpeg_rates = None
 
     primary = rates.get("native", rates["tf"])
     print(json.dumps({

@@ -158,10 +158,15 @@ def extract_features_spmd(apply_fn, batches: Iterator[Dict[str, Any]], mesh,
             y = np.zeros((0,), np.int32)
         dev = shard_batch_to_mesh(pad_batch({"x": x, "y": y}, host_batch),
                                   mesh)
-        f, gy, gm = apply_fn(dev["x"], dev["y"], dev["mask"])
+        with mesh:   # axis names in scope (ring attention shard_map needs
+            f, gy, gm = apply_fn(dev["x"], dev["y"], dev["mask"])  # them)
         keep = np.asarray(gm) > 0.5
         feats.append(np.asarray(f)[keep].astype(np.float32))
         labels.append(np.asarray(gy)[keep])
+    if not feats:
+        raise ValueError(
+            "eval extraction produced no features: every host's iterator "
+            "was empty")
     return np.concatenate(feats), np.concatenate(labels)
 
 

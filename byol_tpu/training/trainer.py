@@ -239,12 +239,38 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         sample_batch = None
         watchdog.pet()
 
+        def epoch_batches():
+            """Exactly ``steps_per_train_epoch`` batches, every epoch, on
+            every host.  The step count is the load-bearing constant (it
+            feeds the EMA tau schedule, reference main.py:424-425), and on
+            pods each train step is an SPMD collective — so a host whose
+            shard yields one batch fewer (interleaved image_folder shards)
+            must WRAP to its shard's start rather than stop early and
+            deadlock the others, and a host with one extra batch must stop
+            at the count (the DistributedSampler pad/truncate analog)."""
+            produced = 0
+            since_reset = 0
+            it = iter(loader.train_loader)
+            while produced < rcfg.steps_per_train_epoch:
+                batch = next(it, None)
+                if batch is None:
+                    if since_reset == 0:
+                        raise ValueError(
+                            "train loader yielded no batches: per-host "
+                            "shard smaller than the host batch")
+                    it = iter(loader.train_loader)
+                    since_reset = 0
+                    continue
+                since_reset += 1
+                yield batch
+                produced += 1
+
         def tapped_batches():
             nonlocal first_batch_checked, sample_batch
             # exact mid-epoch resume: drop the leading batches the preempted
             # run already trained (deterministic order per (seed, epoch))
             skip = resume_skip if epoch == resume_epoch else 0
-            for i, batch in enumerate(loader.train_loader):
+            for i, batch in enumerate(epoch_batches()):
                 if i < skip:
                     continue
                 if not first_batch_checked:

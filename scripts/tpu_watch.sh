@@ -34,8 +34,18 @@ while true; do
         done
         if probe; then
             echo "launching capture $(date -u +%FT%TZ)" >> /tmp/tpu_watch/status
+            # Pause any CPU-mesh evidence run for the duration: one host
+            # core — its load would distort the TPU-side step timings.
+            EV_PIDS=$(pgrep -f run_evidence.py || true)
+            # resume the frozen run EVEN IF this watcher dies mid-capture
+            # (SIGTERM/HUP/kill): a stopped multi-hour training run that
+            # nothing ever CONTs is a silent total loss
+            [ -n "$EV_PIDS" ] && trap "kill -CONT $EV_PIDS 2>/dev/null" EXIT
+            [ -n "$EV_PIDS" ] && kill -STOP $EV_PIDS 2>/dev/null
             bash scripts/tpu_capture.sh > /tmp/tpu_watch/capture.log 2>&1
-            echo "capture done rc=$? $(date -u +%FT%TZ)" >> /tmp/tpu_watch/status
+            rc=$?
+            [ -n "$EV_PIDS" ] && kill -CONT $EV_PIDS 2>/dev/null
+            echo "capture done rc=$rc $(date -u +%FT%TZ)" >> /tmp/tpu_watch/status
             exit 0
         fi
     else

@@ -175,7 +175,7 @@ class TestJpegFusedDecode:
         good = _jpeg_bytes(_imgs(n=1)[0])
         v1, _ = native_aug.jpeg_augment_two_views(
             [b"\xff\xd8\xff\xe0garbage", good[:50], good], 16, seed=0)
-        assert v1[2].max() >= 0.0                # good image decoded
+        assert v1[2].max() > 0.0                 # good image decoded
         np.testing.assert_array_equal(v1[0], 0)  # corrupt -> zeroed
         np.testing.assert_array_equal(v1[1], 0)
 

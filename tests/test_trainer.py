@@ -173,6 +173,39 @@ def test_sigterm_preemption_saves_and_resumes(tmp_path):
 
 
 @pytest.mark.slow
+def test_train_epoch_is_exactly_steps_per_epoch(tmp_path):
+    """The trainer consumes EXACTLY steps_per_train_epoch batches per epoch
+    regardless of what the host's iterator yields: a shard one batch short
+    (interleaved image_folder host shards) WRAPS (DistributedSampler pad
+    analog — on pods stopping early would deadlock the SPMD collectives),
+    and a shard with extra batches stops at the count (the EMA tau schedule
+    is keyed to steps_per_train_epoch, reference main.py:424-425)."""
+    from byol_tpu.data.loader import LoaderBundle
+
+    def make_iter(n_batches, train):
+        def it(epoch):
+            rng = np.random.RandomState(5 + epoch)
+            for _ in range(n_batches):
+                v = rng.rand(16, 16, 16, 3).astype(np.float32)
+                yield {"view1": v, "view2": v,
+                       "label": rng.randint(0, 10, size=(16,)).astype(
+                           np.int32)}
+        return it
+
+    for yielded in (1, 3):      # one short of steps=2, one over
+        loader = LoaderBundle(make_train_iter=make_iter(yielded, True),
+                              make_test_iter=make_iter(1, False),
+                              input_shape=(16, 16, 3),
+                              num_train_samples=32,   # -> steps_per_epoch 2
+                              num_test_samples=16, output_size=10)
+        cfg = _tiny_cfg(tmp_path, task=TaskConfig(
+            task="fake", batch_size=16, epochs=1, image_size_override=16,
+            log_dir=str(tmp_path / "runs"), uid=f"steps{yielded}"))
+        result = fit(cfg, loader=loader, verbose=False)
+        assert int(result.state.step) == 2, yielded
+
+
+@pytest.mark.slow
 def test_fit_eval_remainder_batches(tmp_path):
     """A test set whose size divides by neither the batch size nor the
     8-device data axis (21 = 16 + 5) must work: eval pads the short batch to
