@@ -75,7 +75,7 @@ def _flops_per_sample(arch: str, image_size: int) -> float | None:
 
 def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
            fuse_views: bool, ema_update_mode: str, remat: bool = False,
-           stem: str = "conv"):
+           stem: str = "conv", attn_impl: str = "dense"):
     from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
                                       ParityConfig, TaskConfig, resolve)
     from byol_tpu.parallel.mesh import MeshSpec, build_mesh, shard_batch_to_mesh
@@ -87,7 +87,7 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
         task=TaskConfig(task="fake", batch_size=batch_size * n_dev, epochs=100,
                         image_size_override=image_size),
         model=ModelConfig(arch=arch, fuse_views=fuse_views, remat=remat,
-                          stem=stem),
+                          stem=stem, attn_impl=attn_impl),
         device=DeviceConfig(num_replicas=n_dev, half=half, seed=0),
         parity=ParityConfig(ema_update_mode=ema_update_mode),
     )
@@ -110,11 +110,13 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
 
 def _throughput(batch_size: int, image_size: int, arch: str, *, half: bool,
                 fuse_views: bool, ema_update_mode: str, remat: bool = False,
-                stem: str = "conv", steps: int = 20) -> float:
+                stem: str = "conv", attn_impl: str = "dense",
+                steps: int = 20) -> float:
     """Images/sec/chip for one configuration (global images / sec / n_dev)."""
     state, train_step, batch = _build(
         batch_size, image_size, arch, half=half, fuse_views=fuse_views,
-        ema_update_mode=ema_update_mode, remat=remat, stem=stem)
+        ema_update_mode=ema_update_mode, remat=remat, stem=stem,
+        attn_impl=attn_impl)
     # warmup: compile + 2 steady steps.  NB: sync via a scalar READBACK, not
     # block_until_ready — on tunneled platforms (axon) block_until_ready
     # returns at dispatch-ack and wildly overstates throughput; a D2H read
@@ -334,9 +336,23 @@ def main():
             get_spec(arch_override)
         except ValueError as e:
             raise SystemExit(f"bench: {e}")
+    # Attention backend for ViT archs (--attn dense|flash|ring): lets the
+    # Pallas flash kernel A/B against XLA dense on the same ladder.
+    attn_impl = "dense"
+    if "--attn" in sys.argv[1:]:
+        i = sys.argv.index("--attn") + 1
+        if i >= len(sys.argv) or sys.argv[i] not in ("dense", "flash",
+                                                     "ring"):
+            # fail fast like --arch: a typo here would otherwise record
+            # every ladder rung as "did not fit" (trace-time error)
+            raise SystemExit("usage: bench.py --attn dense|flash|ring")
+        attn_impl = sys.argv[i]
     global _PARTIAL_PATH
     if arch_override and arch_override != "resnet50":
         _PARTIAL_PATH = f"bench_partial_{arch_override}.json"
+    if attn_impl != "dense":
+        _PARTIAL_PATH = _PARTIAL_PATH.replace(
+            ".json", f"_{attn_impl}.json")
     # Persistent compile cache: every config's XLA compile costs minutes over
     # the tunneled backend; caching makes sweep re-runs (and headline re-runs
     # after a mid-sweep backend drop) nearly free to resume.
@@ -442,10 +458,11 @@ def main():
         return
 
     value = best_throughput("tpu_first", half=True, fuse_views=True,
-                            ema_update_mode="post")
+                            ema_update_mode="post", attn_impl=attn_impl)
     baseline = best_throughput("reference_faithful", half=False,
                                fuse_views=False,
-                               ema_update_mode="reference_pre", steps=10)
+                               ema_update_mode="reference_pre", steps=10,
+                               attn_impl=attn_impl)
     # Middle rung: reference SEMANTICS (four forwards, pre-update EMA) at
     # bf16.  Separates what dtype buys from what the redesign buys:
     #   vs_baseline      = tpu_first / fp32-reference   (total win)
@@ -453,7 +470,8 @@ def main():
     #   tpu_first/bf16_ref = redesign alone (fuse_views + post-EMA)
     bf16_ref = best_throughput("reference_semantics_bf16", half=True,
                                fuse_views=False,
-                               ema_update_mode="reference_pre", steps=10)
+                               ema_update_mode="reference_pre", steps=10,
+                               attn_impl=attn_impl)
     if value is None:
         if _backend_dead:
             raise RuntimeError(

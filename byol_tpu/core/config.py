@@ -207,7 +207,12 @@ class ResolvedConfig:
     total_train_steps: int                  # ref main.py:425
     batch_size_per_replica: int             # global // num_replicas (ref main.py:725)
     representation_size: int                # derived from arch registry (fixes Q8)
-    num_valid_samples: int = 0              # per-replica (ref main.py:423)
+    num_valid_samples: int = 0              # per-replica (ref main.py:423).
+                                            # Informational parity surface:
+                                            # the reference derives it onto
+                                            # args and barely consumes it;
+                                            # loader counts stay the
+                                            # authoritative split sizes.
 
     @property
     def global_batch_size(self) -> int:

@@ -44,13 +44,31 @@ def lockstep_iter(batches: Iterator[T], pad_fn: Callable[[], T]
     it = iter(batches)
     single = jax.process_count() == 1
     while True:
-        batch = next(it, None)
+        err = None
+        try:
+            batch = next(it, None)
+        except Exception as e:
+            # A host whose iterator RAISES (unreadable file mid-shard) must
+            # broadcast the failure — silently exiting would leave every
+            # peer blocked in the next collective forever.  Status 2 turns
+            # the hang into a synchronized failure on all hosts.
+            batch, err = None, e
         if single:
+            if err is not None:
+                raise err
             if batch is None:
                 return
             yield batch
             continue
-        statuses = all_status(1 if batch is not None else 0)
+        statuses = all_status(2 if err is not None
+                              else (1 if batch is not None else 0))
+        if (statuses == 2).any():
+            if err is not None:
+                raise err
+            raise RuntimeError(
+                f"eval iterator failed on host(s) "
+                f"{np.nonzero(statuses == 2)[0].tolist()}; failing in "
+                "lockstep instead of deadlocking")
         if not (statuses == 1).any():
             return
         yield batch if batch is not None else pad_fn()

@@ -132,21 +132,29 @@ def extract_features_spmd(apply_fn, batches: Iterator[Dict[str, Any]], mesh,
         it = itertools.islice(it, jax.process_index(), None,
                               jax.process_count())
     while True:
-        batch = next(it, None)
-        # status codes: 0 = drained, 1 = has data, 2 = error (an empty
-        # shard with no shape template cannot even feed pad batches — fail
-        # every peer in the same round instead of deadlocking the next
-        # collective)
+        # status codes: 0 = drained, 1 = has data, 2 = error.  A host that
+        # CANNOT continue — iterator raised (unreadable file), or an empty
+        # shard with no shape template to pad from — must broadcast the
+        # failure so every peer raises in the same round instead of
+        # blocking forever in the next collective.
+        err = None
+        try:
+            batch = next(it, None)
+        except Exception as e:
+            batch, err = None, e
         status = 1 if batch is not None else 0
-        if batch is None and template is None:
+        if err is not None or (batch is None and template is None):
             status = 2
         statuses = all_status(status)
         if (statuses == 2).any():
+            if err is not None:
+                raise err
             raise ValueError(
-                f"eval extraction cannot proceed: host(s) "
-                f"{np.nonzero(statuses == 2)[0].tolist()} have an empty "
-                "shard and no batch-shape template; use equal-size shards "
-                "or shard_eval=False")
+                f"eval extraction cannot proceed on host(s) "
+                f"{np.nonzero(statuses == 2)[0].tolist()}: iterator "
+                "failure, or an empty shard with no batch-shape template "
+                "(use equal-size shards, shard_eval=False, or pass "
+                "sample_shape)")
         if not (statuses == 1).any():
             break
         if batch is not None:

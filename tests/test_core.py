@@ -25,12 +25,15 @@ class TestResolve:
         cfg = _cfg(batch_size=1024, epochs=100)
         r = config_lib.resolve(cfg, num_train_samples=50000,
                                num_test_samples=10000, output_size=10,
-                               input_shape=(224, 224, 3))
+                               input_shape=(224, 224, 3),
+                               num_valid_samples=5000)
         assert r.batch_size_per_replica == 128
         assert r.num_train_samples == 6250
         assert r.steps_per_train_epoch == 48
         assert r.total_train_steps == 4800
         assert r.num_test_samples == 10000  # test not sharded (main.py:422)
+        assert r.num_valid_samples == 625   # valid sharded like train
+                                            # (main.py:423)
 
     def test_indivisible_batch_raises(self):
         cfg = _cfg(batch_size=100)
