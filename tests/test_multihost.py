@@ -63,3 +63,62 @@ def test_two_process_train_step():
     # the TRAIN features span both hosts' shards (8 + 8) and the replicated
     # test set was kept once, not twice (Quirk Q9 de-dup)
     assert evals[0][1] == 16 and evals[0][2] == 4
+
+
+_IF_WORKER = os.path.join(os.path.dirname(__file__),
+                          "_multihost_imagefolder_worker.py")
+
+
+@pytest.mark.slow
+def test_two_process_imagefolder_uneven_shards(tmp_path):
+    """The hard pod case: an image_folder tree whose interleaved per-host
+    shards are UNEVEN (11 train / 7 test files over 2 hosts).  Naive
+    iteration would hand the hosts different train/eval batch counts and
+    deadlock the SPMD collectives; the run must instead complete a full
+    fit() (epoch pinned to steps_per_train_epoch on every host, eval in
+    lockstep) plus the SPMD offline linear eval, with both ranks reporting
+    identical step counters, losses, and probe results."""
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.RandomState(7)
+    for split, n in (("train", 11), ("test", 7)):
+        for i in range(n):
+            cls = i % 2
+            d = tmp_path / split / f"{cls}"
+            d.mkdir(parents=True, exist_ok=True)
+            arr = rng.randint(0, 255, (24, 24, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.jpg")
+
+    port = str(21000 + os.getpid() % 20000)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(_IF_WORKER))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, _IF_WORKER, str(rank), port, str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        text=True) for rank in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank{rank} failed:\n{out[-3000:]}"
+    fits, evals = [], []
+    for out in outs:
+        m = re.search(r"FIT ok step=(\d+) test_loss=(-?\d+\.\d+)", out)
+        assert m, out[-2000:]
+        fits.append((int(m.group(1)), float(m.group(2))))
+        m = re.search(r"LE top1=(-?\d+\.\d+) ntrain=(\d+) ntest=(\d+)", out)
+        assert m, out[-2000:]
+        evals.append((float(m.group(1)), int(m.group(2)), int(m.group(3))))
+    assert fits[0] == fits[1]        # same steps, same SPMD test loss
+    assert evals[0] == evals[1]      # identical probe on both ranks
+    # all 11 train files' features were gathered (6 + 5 across hosts)
+    assert evals[0][1] == 11 and evals[0][2] == 7
