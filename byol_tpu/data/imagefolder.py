@@ -162,9 +162,18 @@ def image_folder_loader(cfg: Config, *, host_batch: int,
                     blobs = list(pool.map(
                         lambda p: open(p, "rb").read(), paths_t[take]))
                     if train:
+                        # process_index mixed into the seed: index_base is
+                        # shard-LOCAL, so without it every host at the same
+                        # epoch position would draw identical crop/jitter
+                        # parameters for different images (ADVICE r4).  The
+                        # C++ side multiplies seed by the splitmix64
+                        # constant, so distinct seeds are disjoint stream
+                        # families; single-host runs (index 0) keep the
+                        # committed evidence streams unchanged.
                         v1, v2 = native_aug.jpeg_augment_two_views(
                             blobs, size, color_jitter_strength=cj,
-                            seed=seed + 1_000_003 * epoch,
+                            seed=(seed + 1_000_003 * epoch
+                                  + 7_919 * index),
                             index_base=int(lo), num_threads=workers)
                     else:
                         v1 = native_aug.jpeg_resize_batch(
@@ -235,8 +244,13 @@ def image_folder_loader(cfg: Config, *, host_batch: int,
             def _load(ex):
                 data = tf.io.read_file(ex["path"])
                 if train:
+                    # 100_003 * process_index: same cross-host
+                    # decorrelation as the native path (ex["index"] is
+                    # shard-local); epochs stay well below 100_003, so
+                    # (epoch, host) seed pairs never collide
                     s0 = tf.stack([tf.cast(ex["index"], tf.int32),
-                                   tf.constant(seed, tf.int32) + epoch])
+                                   tf.constant(seed, tf.int32) + epoch
+                                   + 100_003 * index])
                     # Proper seed splitting (not additive offsets, which
                     # collide across samples: i's view2 == (i+k)'s view1).
                     view_seeds = augment._split(s0, 2)

@@ -332,5 +332,19 @@ def run_linear_eval_from_cfg(cfg, state, *, loader=None, mesh=None,
     test_x, test_y = extract_features_spmd(
         apply_fn, loader.test_loader, mesh, host_batch=host_batch,
         replicated_data=not eval_sharded, sample_shape=loader.input_shape)
+    # Sanity check (ADVICE r4): a caller-built bundle whose test iterator
+    # IS per-host sharded but whose eval_sharded flag says replicated gets
+    # round-robin dealing over genuinely different shards — silently
+    # scoring the probe on 1/P of the test set.  The gathered pod-global
+    # label count exposes that wiring error exactly.
+    n_expected = int(getattr(loader, "num_test_samples", 0) or 0)
+    if n_expected and len(test_y) != n_expected:
+        raise ValueError(
+            f"linear eval gathered {len(test_y)} test samples but the "
+            f"loader reports num_test_samples={n_expected}: the bundle's "
+            f"eval_sharded flag ({eval_sharded}) does not match how its "
+            "test iterator is actually sharded (dealing over per-host "
+            "shards drops samples; masking over replicated data "
+            "double-counts none but gathers all)")
     return fit_and_score(train_x, train_y, test_x, test_y,
                          loader.output_size, epochs=epochs, seed=seed)

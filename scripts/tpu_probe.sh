@@ -1,0 +1,19 @@
+# Shared TPU-tunnel probe, sourced by tpu_watch.sh and tpu_capture.sh so
+# the two can never drift (the watcher's copy once gained the
+# chip-in-use guard while the capture's lacked it).
+#
+# 60s-timeout matmul with a scalar D2H readback — block_until_ready lies
+# over axon (returns at dispatch-ack) — plus a platform assert: on a dead
+# accelerator jax silently falls back to cpu, which must count as DOWN.
+#
+# Callers that might race another chip holder add their own pgrep guard
+# BEFORE calling (the TPU is single-process-exclusive; probing a busy
+# chip hangs without meaning the tunnel is down).
+tpu_probe() {
+    timeout 60 python - <<'EOF' > /dev/null 2>&1
+import jax, jax.numpy as jnp
+assert jax.default_backend() != "cpu", jax.default_backend()
+x = jnp.ones((256, 256))
+print(float((x @ x).sum()))
+EOF
+}

@@ -1,14 +1,22 @@
 #!/usr/bin/env bash
-# Background TPU-tunnel watcher (round-3 outage pattern: the tunnel drops
-# for hours, then comes back — the first reachable window must not be
-# missed).  Loops a 60s-timeout probe matmul every ~5 min; on first
-# success, waits for any running pytest to finish (one CPU core: host
-# starvation would distort TPU step timings) and launches
-# scripts/tpu_capture.sh.  Writes state to /tmp/tpu_watch/.
+# Background TPU-tunnel watcher (rounds 1-4 outage pattern: the tunnel
+# drops for hours, then comes back — no reachable window may be missed).
+# Loops a 60s-timeout probe matmul every ~5 min; on success, waits for
+# any running pytest to finish (one CPU core: host starvation would
+# distort TPU step timings) and launches scripts/tpu_capture.sh.
+#
+# Round-5 change: the capture is STAGED and RESUMABLE (each stage commits
+# its artifacts; done-markers skip finished stages), so this watcher no
+# longer exits after the first capture attempt — it keeps looping until
+# the capture exits 0 (all stages complete).  A short window that lands
+# only stage 1 is a success, not a lost round.
 set -u
 cd "$(dirname "$0")/.."
+. scripts/tpu_probe.sh
 mkdir -p /tmp/tpu_watch
-echo "watch started $(date -u +%FT%TZ)" > /tmp/tpu_watch/status
+# append, never truncate: the status file is the round's outage record
+# (committed as evidence alongside any stale bench)
+echo "watch started $(date -u +%FT%TZ)" >> /tmp/tpu_watch/status
 
 probe() {
     # NB only probe when no other process holds the chip: the TPU is
@@ -17,11 +25,7 @@ probe() {
     if pgrep -f "tpu_capture.sh" > /dev/null; then
         return 1
     fi
-    timeout 60 python - <<'EOF' > /dev/null 2>&1
-import jax, jax.numpy as jnp
-x = jnp.ones((256, 256))
-print(float((x @ x).sum()))
-EOF
+    tpu_probe
 }
 
 while true; do
@@ -34,9 +38,10 @@ while true; do
         done
         if probe; then
             echo "launching capture $(date -u +%FT%TZ)" >> /tmp/tpu_watch/status
-            # Pause any CPU-mesh evidence run for the duration: one host
-            # core — its load would distort the TPU-side step timings.
-            EV_PIDS=$(pgrep -f run_evidence.py || true)
+            # Pause any CPU evidence run for the duration (pattern matches
+            # run_evidence.py AND run_evidence_seeds.py): one host core —
+            # its load would distort the TPU-side step timings.
+            EV_PIDS=$(pgrep -f "run_evidence" || true)
             # resume the frozen run EVEN IF this watcher dies mid-capture
             # (SIGTERM/HUP/kill): a stopped multi-hour training run that
             # nothing ever CONTs is a silent total loss
@@ -45,8 +50,14 @@ while true; do
             bash scripts/tpu_capture.sh > /tmp/tpu_watch/capture.log 2>&1
             rc=$?
             [ -n "$EV_PIDS" ] && kill -CONT $EV_PIDS 2>/dev/null
-            echo "capture done rc=$rc $(date -u +%FT%TZ)" >> /tmp/tpu_watch/status
-            exit 0
+            trap - EXIT
+            echo "capture attempt rc=$rc $(date -u +%FT%TZ)" >> /tmp/tpu_watch/status
+            if [ "$rc" -eq 0 ]; then
+                echo "all capture stages complete $(date -u +%FT%TZ)" >> /tmp/tpu_watch/status
+                exit 0
+            fi
+            # rc=2: tunnel lost mid-capture — finished stages are already
+            # committed; keep looping for the next window
         fi
     else
         echo "probe down $(date -u +%FT%TZ)" >> /tmp/tpu_watch/status

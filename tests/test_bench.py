@@ -208,6 +208,32 @@ class TestSweepResume:
         assert sum(r.get("images_per_sec_per_chip") == 709.4
                    for r in rows) == 1
 
+    def test_truncated_sweep_exits_nonzero(self, bench, monkeypatch,
+                                           capsys):
+        # a backend death mid-grid must not exit 0: the staged capture
+        # marks a stage done on success, and a truncated sweep marked
+        # complete would never resume its remaining rows
+        self._fake_tpu(bench, monkeypatch)
+        calls = []
+
+        def dying_throughput(bs, *a, **kw):
+            calls.append(bs)
+            if len(calls) >= 2:
+                bench._backend_dead = True   # as _config_failed would set
+                raise RuntimeError("UNAVAILABLE: Socket closed")
+            return 100.0
+        monkeypatch.setattr(bench, "_throughput", dying_throughput)
+        monkeypatch.setattr(bench, "_config_failed",
+                            lambda ctx, e: bench._backend_dead)
+        monkeypatch.setattr(bench.jax, "default_backend", lambda: "tpu")
+        with pytest.raises(SystemExit) as exc:
+            bench._sweep("resnet50", 224, [512, 256], lambda v: 0.1)
+        assert exc.value.code == 3
+        out = json.loads(capsys.readouterr().out)
+        assert out["complete"] is False and out["value"] == 1
+        # the row measured before the death was still written
+        assert len(json.load(open("bench_sweep.json"))) == 1
+
     def test_sweep_table_rotated_not_clobbered(self, bench, monkeypatch):
         # a partial re-run must never destroy a complete prior table: the
         # existing bench_sweep.json moves to .prev before the new write
@@ -287,6 +313,158 @@ class TestSweepResume:
         reused = [r for r in rows
                   if r.get("images_per_sec_per_chip") == 709.4]
         assert len(reused) == 1      # measured row carried into the table
+
+
+class TestMVC:
+    """--mvc (minimum-viable capture) must fit a short tunnel window:
+    one rung per headline family at the best KNOWN batch size, the
+    rematted bs512 row under the sweep naming contract, and a fresh
+    (never stale) headline line."""
+
+    _PRIOR = {
+        "device_kind": "TPU v5 lite", "arch": "resnet50",
+        "results": [
+            {"config": "tpu_first", "batch_per_chip": 512, "fit": True,
+             "images_per_sec_per_chip": 715.6, "mfu": 0.238},
+            {"config": "tpu_first", "batch_per_chip": 256, "fit": True,
+             "images_per_sec_per_chip": 776.1, "mfu": 0.258},
+            {"config": "reference_faithful", "batch_per_chip": 128,
+             "fit": True, "images_per_sec_per_chip": 495.7, "mfu": 0.165},
+        ],
+    }
+
+    @staticmethod
+    def _fake_tpu(bench, monkeypatch):
+        import types
+        monkeypatch.setattr(
+            bench.jax, "devices",
+            lambda: [types.SimpleNamespace(device_kind="TPU v5 lite")])
+
+    def test_refuses_stale_fallback(self, bench, monkeypatch):
+        import sys as _sys
+        with open("bench_partial.json", "w") as f:
+            json.dump(self._PRIOR, f)
+        bench._preflight_backend = lambda *a, **k: False
+        monkeypatch.setattr(_sys, "argv", ["bench.py", "--mvc"])
+        with pytest.raises(SystemExit, match="needs live hardware"):
+            bench.main()
+
+    def test_prior_best_rungs_prefers_fastest_fit(self, bench, monkeypatch):
+        self._fake_tpu(bench, monkeypatch)
+        with open("bench_partial.json", "w") as f:
+            json.dump(self._PRIOR, f)
+        rungs = bench._prior_best_rungs()
+        # bs256 is the FASTER tpu_first rung even though 512 also fits
+        assert rungs["tpu_first"] == 256
+        assert rungs["reference_faithful"] == 128
+
+    def test_other_device_kind_rungs_ignored(self, bench, monkeypatch):
+        import types
+        monkeypatch.setattr(
+            bench.jax, "devices",
+            lambda: [types.SimpleNamespace(device_kind="TPU v4")])
+        with open("bench_partial.json", "w") as f:
+            json.dump(self._PRIOR, f)
+        assert bench._prior_best_rungs() == {}
+
+    def _run_mvc(self, bench, monkeypatch, capsys, fail_at=()):
+        self._fake_tpu(bench, monkeypatch)
+        with open("bench_partial.json", "w") as f:
+            json.dump(self._PRIOR, f)
+        measured = []
+
+        def fake_throughput(bs, image_size, arch, **kw):
+            measured.append((bs, kw.get("remat", False),
+                             kw["ema_update_mode"], kw["half"]))
+            if (bs, kw.get("remat", False)) in fail_at:
+                raise RuntimeError("XLA compile error")
+            return 700.0
+        monkeypatch.setattr(bench, "_throughput", fake_throughput)
+        # main() stamps device metadata on _partial before dispatching to
+        # _mvc; the sweep-reuse contract keys on it
+        bench._partial.update(device_kind="TPU v5 lite", arch="resnet50")
+        bench._mvc("resnet50", 224, [1024, 512, 256, 128, 64, 32], True,
+                   lambda v: 0.25, "dense")
+        out = json.loads(capsys.readouterr().out)
+        return measured, out
+
+    def test_one_rung_per_family_plus_remat_row(self, bench, monkeypatch,
+                                                capsys):
+        measured, out = self._run_mvc(bench, monkeypatch, capsys)
+        # exactly one rung per family, at the prior best-known batch
+        assert measured == [
+            (256, False, "post", True),            # tpu_first @ prior best
+            (128, False, "reference_pre", False),  # reference_faithful
+            (256, False, "reference_pre", True),   # bf16 middle rung
+            (512, True, "post", True),             # the rematted sweep row
+        ]
+        assert out["value"] == 700.0 and "stale" not in out
+        assert out["vs_baseline"] == 1.0
+        assert out["dtype_gain"] == 1.0 and out["redesign_gain"] == 1.0
+        # the remat row is recorded under the sweep naming contract, so a
+        # later full --sweep reuses it (_sweep_prior_rows)
+        rows = json.load(open("bench_partial.json"))["results"]
+        remat = [r for r in rows
+                 if r["config"] == "sweep_bs512_remat1_fuse1"]
+        assert remat and remat[0]["fit"] and remat[0]["remat"] is True
+        prior = bench._sweep_prior_rows()
+        assert "sweep_bs512_remat1_fuse1" in prior
+
+    def test_failed_rung_steps_down_once(self, bench, monkeypatch, capsys):
+        measured, out = self._run_mvc(bench, monkeypatch, capsys,
+                                      fail_at={(256, False)})
+        # 256 fails for tpu_first AND bf16_ref; each steps down exactly once
+        assert (128, False, "post", True) in measured
+        assert (128, False, "reference_pre", True) in measured
+        assert out["value"] == 700.0
+
+    def test_headline_survives_missing_families(self, bench, monkeypatch,
+                                                capsys):
+        # every non-primary family failing entirely must still print a
+        # fresh headline (vs_baseline null), never crash the capture
+        measured, out = self._run_mvc(
+            bench, monkeypatch, capsys,
+            fail_at={(128, False), (64, False), (512, True)})
+        assert out["value"] == 700.0
+        assert out["vs_baseline"] is None and "dtype_gain" not in out
+
+
+class TestKnownOOM:
+    """The un-rematted rn50@224 bs1024 compile once crashed the
+    remote-compile service for hours — no ladder may ever re-attempt it."""
+
+    def test_truth_table(self, bench):
+        assert bench._known_oom(1024, "resnet50", 224)
+        assert bench._known_oom(1024, "resnet50", 224, remat=False)
+        assert not bench._known_oom(1024, "resnet50", 224, remat=True)
+        assert not bench._known_oom(512, "resnet50", 224)
+        assert not bench._known_oom(1024, "vit_b16", 224)   # own ladders
+        assert not bench._known_oom(1024, "resnet50", 96)   # start below
+
+    def test_headline_ladder_skips_and_records(self, bench, monkeypatch,
+                                               capsys):
+        import sys as _sys
+        import types
+        monkeypatch.setattr(
+            bench.jax, "devices",
+            lambda: [types.SimpleNamespace(device_kind="TPU v5 lite")])
+        monkeypatch.setattr(bench.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(bench.jax.config, "update", lambda *a: None)
+        monkeypatch.setattr(_sys, "argv", ["bench.py"])
+        bench._preflight_backend = lambda *a, **k: True
+        attempted = []
+
+        def fake_throughput(bs, *a, **kw):
+            attempted.append(bs)
+            return 500.0
+        monkeypatch.setattr(bench, "_throughput", fake_throughput)
+        bench.main()
+        assert 1024 not in attempted       # never compiled
+        rows = json.load(open("bench_partial.json"))["results"]
+        skipped = [r for r in rows if r.get("batch_per_chip") == 1024]
+        assert skipped and all("documented" in r["error"] for r in skipped)
+        out = json.loads(capsys.readouterr().out)
+        assert out["value"] == 500.0 and "stale" not in out
 
 
 class TestMFUAccounting:
