@@ -125,6 +125,55 @@ class TestImageFolder:
         np.testing.assert_array_equal(te_batch["view1"], te_batch["view2"])
         assert te_batch["view1"].shape == (4, 32, 32, 3)
 
+    @pytest.mark.parametrize("backend", ["tf", "native"])
+    def test_cross_host_augmentation_decorrelation(self, tmp_path, backend,
+                                                   monkeypatch):
+        # ADVICE r4: per-sample augmentation seeds were shard-LOCAL, so
+        # hosts at the same epoch position drew identical crop/jitter
+        # parameters for different images.  process_index is now mixed
+        # into the seed: same host => bit-identical streams (determinism
+        # preserved), different host => different streams.
+        #
+        # Tree construction isolates the seed: every file within a class
+        # is byte-identical, classes have EVEN counts, so under 2-host
+        # interleaved sharding both shards carry identical (image, label)
+        # sequences and the per-epoch shuffle (same seed, same length)
+        # orders them identically — any view difference is augmentation.
+        from PIL import Image
+        if backend == "native":
+            from byol_tpu.data import native_aug
+            if not (native_aug.available() and native_aug.has_jpeg()):
+                pytest.skip("native backend unavailable")
+        rng = np.random.RandomState(7)
+        for split, n in (("train", 4), ("test", 2)):
+            for cls in ("cat", "dog"):
+                d = tmp_path / split / cls
+                d.mkdir(parents=True)
+                arr = rng.randint(0, 255, (48, 40, 3), dtype=np.uint8)
+                for i in range(n):
+                    Image.fromarray(arr).save(d / f"{i}.jpg", quality=95)
+
+        def first_views(pidx):
+            import jax as jax_mod
+            monkeypatch.setattr(jax_mod, "process_index", lambda: pidx)
+            monkeypatch.setattr(jax_mod, "process_count", lambda: 2)
+            cfg = Config(
+                task=TaskConfig(task="image_folder", data_dir=str(tmp_path),
+                                batch_size=4, image_size_override=32,
+                                data_backend=backend),
+                device=DeviceConfig(num_replicas=1, seed=0))
+            bundle = get_loader(cfg)
+            bundle.set_all_epochs(0)
+            b = next(bundle.train_loader)
+            return np.asarray(b["view1"]), np.asarray(b["label"])
+
+        v_h0, l_h0 = first_views(0)
+        v_h0b, _ = first_views(0)
+        v_h1, l_h1 = first_views(1)
+        np.testing.assert_array_equal(l_h0, l_h1)     # identical shards
+        np.testing.assert_array_equal(v_h0, v_h0b)    # deterministic
+        assert not np.array_equal(v_h0, v_h1)         # decorrelated
+
     def test_valid_root_on_disk(self, tree):
         # an on-disk valid/ root wins over valid_fraction (image_folder)
         from PIL import Image
