@@ -29,6 +29,11 @@ sample: 2 online forwards + 2 target forwards + backward (~2x the online
 forwards) = 8 encoder-forward-equivalents; head MLP/probe FLOPs are <1% of
 the RN50 trunk at 224px and are ignored.
 
+Every measured row now carries ``compile_seconds`` and
+``hbm_high_water_bytes`` (from ``jit(...).lower(...).compile()
+.memory_analysis()``), so spill/OOM regimes are visible in BENCH_*.json
+without reading OOM dumps.
+
 Usage:
   python bench.py                  # the two headline configs -> one JSON line
   python bench.py --mvc            # minimum-viable capture: one rung per
@@ -38,6 +43,16 @@ Usage:
   python bench.py --profile DIR    # jax.profiler trace of the headline config
   python bench.py --stem-ab        # conv vs space_to_depth stem A/B
   python bench.py --data           # host data pipeline: tf vs native C++
+  python bench.py --accum-ladder   # microbatch-accumulation ladder: effective
+                                   #   512/1024/4096 at the per-chip-optimal
+                                   #   microbatch (256), each rung's compile
+                                   #   gated behind a killable subprocess
+                                   #   timeout; records compile_seconds +
+                                   #   HBM high-water + img/s/chip
+  python bench.py --dry-compile    # AOT-compile ONE accumulation config
+                                   #   (default: effective 4096 @ microbatch
+                                   #   256, --remat-policy dots) and report
+                                   #   memory_analysis() without executing
 """
 from __future__ import annotations
 
@@ -76,11 +91,64 @@ def _flops_per_sample(arch: str, image_size: int) -> float | None:
     return 8.0 * gmacs * 2.0 * 1e9
 
 
+class _Rate(float):
+    """img/s/chip that also carries per-rung compile/memory side-channel
+    stats (``compile_seconds``, ``hbm_high_water_bytes``, ...) for the JSON
+    rows — arithmetic call sites keep treating it as a plain float."""
+
+    stats: dict = {}
+
+    def __new__(cls, value, stats=None):
+        r = super().__new__(cls, value)
+        r.stats = dict(stats or {})
+        return r
+
+
+def _row_stats(val) -> dict:
+    return dict(getattr(val, "stats", {}) or {})
+
+
+def _memory_stats(compiled) -> dict:
+    """Extract the HBM picture from ``compiled.memory_analysis()``.
+
+    ``hbm_high_water_bytes`` is the executable's device-memory high-water
+    mark: arguments + outputs + XLA temp buffers, minus donated aliases
+    (donation makes the output share the argument buffer).  Best-effort:
+    a backend without the analysis yields {} rather than failing the rung.
+    """
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if mem is None:
+        return {}
+    out = {}
+    for key in ("temp_size_in_bytes", "argument_size_in_bytes",
+                "output_size_in_bytes", "alias_size_in_bytes",
+                "generated_code_size_in_bytes"):
+        v = getattr(mem, key, None)
+        if v is not None:
+            out[key] = int(v)
+    peak = getattr(mem, "peak_memory_in_bytes", None)
+    if peak is not None and int(peak) > 0:
+        out["hbm_high_water_bytes"] = int(peak)
+    elif "temp_size_in_bytes" in out:
+        out["hbm_high_water_bytes"] = (
+            out.get("argument_size_in_bytes", 0)
+            + out.get("output_size_in_bytes", 0)
+            - out.get("alias_size_in_bytes", 0)
+            + out["temp_size_in_bytes"])
+    return out
+
+
 def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
            fuse_views: bool, ema_update_mode: str, remat: bool = False,
-           stem: str = "conv", attn_impl: str = "dense"):
+           stem: str = "conv", attn_impl: str = "dense",
+           accum_steps: int = 1, accum_bn_mode: str = "average",
+           remat_policy: str = "none", materialize_batch: bool = True):
     from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
-                                      ParityConfig, TaskConfig, resolve)
+                                      OptimConfig, ParityConfig, TaskConfig,
+                                      resolve)
     from byol_tpu.parallel.mesh import MeshSpec, build_mesh, shard_batch_to_mesh
     from byol_tpu.training.build import setup_training
 
@@ -90,7 +158,10 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
         task=TaskConfig(task="fake", batch_size=batch_size * n_dev, epochs=100,
                         image_size_override=image_size),
         model=ModelConfig(arch=arch, fuse_views=fuse_views, remat=remat,
+                          remat_policy=remat_policy,
                           stem=stem, attn_impl=attn_impl),
+        optim=OptimConfig(accum_steps=accum_steps,
+                          accum_bn_mode=accum_bn_mode),
         device=DeviceConfig(num_replicas=n_dev, half=half, seed=0),
         parity=ParityConfig(ema_update_mode=ema_update_mode),
     )
@@ -101,40 +172,70 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
         rcfg, mesh, jax.random.PRNGKey(0))
 
     b = cfg.task.batch_size
-    rng = np.random.RandomState(0)
+    if not materialize_batch:
+        # Compile-only paths lower against shapes + shardings; no pixels.
+        return state, train_step, _abstract_batch(b, image_size, mesh), mesh
+    # fp32-native generation: RandomState.rand materializes a float64
+    # intermediate, which at the effective-4096 rung is a ~40 GB host
+    # transient PER VIEW — enough to OOM the 1-core TPU host before the
+    # measurement starts.
+    rng = np.random.default_rng(0)
     batch = {
-        "view1": rng.rand(b, image_size, image_size, 3).astype(np.float32),
-        "view2": rng.rand(b, image_size, image_size, 3).astype(np.float32),
-        "label": rng.randint(0, 1000, size=(b,)).astype(np.int32),
+        "view1": rng.random((b, image_size, image_size, 3),
+                            dtype=np.float32),
+        "view2": rng.random((b, image_size, image_size, 3),
+                            dtype=np.float32),
+        "label": rng.integers(0, 1000, size=(b,)).astype(np.int32),
     }
     batch = shard_batch_to_mesh(batch, mesh)
-    return state, train_step, batch
+    return state, train_step, batch, mesh
+
+
+def _aot_compile(train_step, state, batch, mesh):
+    """AOT lower+compile the step ONCE; returns (compiled, stats).
+
+    The explicit lower/compile (instead of compile-on-first-call) is what
+    makes ``compile_seconds`` and ``memory_analysis()`` observable per rung;
+    the returned executable is then used for the measurement itself, so the
+    rung still compiles exactly once.
+    """
+    fn = getattr(train_step, "__wrapped__", train_step)
+    t0 = time.perf_counter()
+    with mesh:
+        compiled = fn.lower(state, batch).compile()
+    stats = {"compile_seconds": round(time.perf_counter() - t0, 2)}
+    stats.update(_memory_stats(compiled))
+    return compiled, stats
 
 
 def _throughput(batch_size: int, image_size: int, arch: str, *, half: bool,
                 fuse_views: bool, ema_update_mode: str, remat: bool = False,
                 stem: str = "conv", attn_impl: str = "dense",
-                steps: int = 20) -> float:
-    """Images/sec/chip for one configuration (global images / sec / n_dev)."""
-    state, train_step, batch = _build(
+                accum_steps: int = 1, accum_bn_mode: str = "average",
+                remat_policy: str = "none", steps: int = 20) -> _Rate:
+    """Images/sec/chip for one configuration (global images / sec / n_dev);
+    the returned float carries compile/HBM stats (``_Rate.stats``)."""
+    state, train_step, batch, mesh = _build(
         batch_size, image_size, arch, half=half, fuse_views=fuse_views,
         ema_update_mode=ema_update_mode, remat=remat, stem=stem,
-        attn_impl=attn_impl)
-    # warmup: compile + 2 steady steps.  NB: sync via a scalar READBACK, not
+        attn_impl=attn_impl, accum_steps=accum_steps,
+        accum_bn_mode=accum_bn_mode, remat_policy=remat_policy)
+    compiled, stats = _aot_compile(train_step, state, batch, mesh)
+    # warmup: 3 steady steps.  NB: sync via a scalar READBACK, not
     # block_until_ready — on tunneled platforms (axon) block_until_ready
     # returns at dispatch-ack and wildly overstates throughput; a D2H read
     # of a value that depends on the whole step chain cannot lie.
     for _ in range(3):
-        state, metrics = train_step(state, batch)
+        state, metrics = compiled(state, batch)
     float(metrics["loss_mean"])
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, metrics = train_step(state, batch)
+        state, metrics = compiled(state, batch)
     float(metrics["loss_mean"])
     dt = time.perf_counter() - t0
     n_dev = len(jax.devices())
     global_batch = batch["label"].shape[0]
-    return global_batch * steps / dt / n_dev
+    return _Rate(global_batch * steps / dt / n_dev, stats)
 
 
 _PARTIAL_PATH = "bench_partial.json"
@@ -375,7 +476,8 @@ def main():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     if not _preflight_backend():
-        mode = {"--sweep", "--profile", "--stem-ab", "--mvc"} \
+        mode = {"--sweep", "--profile", "--stem-ab", "--mvc",
+                "--accum-ladder", "--dry-compile"} \
             & set(sys.argv[1:])
         if mode:
             # only the headline has a committed artifact to fall back to;
@@ -386,6 +488,12 @@ def main():
                 "live hardware (no stale fallback for non-headline modes)")
         _emit_stale_or_die()
         return
+    accum_gates = None
+    if "--accum-ladder" in sys.argv[1:]:
+        # Gate children must claim the single-client TPU before the
+        # in-process backend init below pins it to this process.
+        accum_gates = _accum_gate_phase(_probe_backend_is_accel(),
+                                        arch_override, attn_impl)
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
         arch, image_size = arch_override or "resnet50", 224
@@ -443,6 +551,7 @@ def main():
                 continue
             _record(name, batch_per_chip=bs, fit=True,
                     images_per_sec_per_chip=round(val, 2), mfu=mfu_of(val),
+                    **_row_stats(val),
                     **{k: v for k, v in kw.items() if k != "steps"})
             best = val if best is None else max(best, val)
             measured += 1
@@ -479,6 +588,13 @@ def main():
         return
     if "--mvc" in sys.argv[1:]:
         _mvc(arch, image_size, candidates, on_tpu, mfu_of, attn_impl)
+        return
+    if "--dry-compile" in sys.argv[1:]:
+        _dry_compile(arch, image_size, on_tpu, attn_impl)
+        return
+    if "--accum-ladder" in sys.argv[1:]:
+        _accum_ladder(arch, image_size, on_tpu, mfu_of, attn_impl,
+                      accum_gates)
         return
 
     value = best_throughput("tpu_first", half=True, fuse_views=True,
@@ -579,7 +695,7 @@ def _mvc(arch, image_size, candidates, on_tpu, mfu_of, attn_impl):
                 continue
             _record(name, batch_per_chip=bs, fit=True,
                     images_per_sec_per_chip=round(val, 2), mfu=mfu_of(val),
-                    **kw)
+                    **_row_stats(val), **kw)
             return val                   # MVC: first fitting rung only
         return None
 
@@ -608,7 +724,8 @@ def _mvc(arch, image_size, candidates, on_tpu, mfu_of, attn_impl):
                               ema_update_mode="post", attn_impl=attn_impl)
             _record(name, fit=True, batch_per_chip=remat_bs, remat=True,
                     fuse_views=True,
-                    images_per_sec_per_chip=round(val, 2), mfu=mfu_of(val))
+                    images_per_sec_per_chip=round(val, 2), mfu=mfu_of(val),
+                    **_row_stats(val))
         except Exception as e:
             if not _config_failed(f"mvc {name}", e):
                 _record(name, batch_per_chip=remat_bs, fit=False,
@@ -670,8 +787,9 @@ def _profile(arch, image_size, candidates, logdir):
     if not rates:
         raise RuntimeError("no batch size fit for profiling")
     bs = max(rates)[1]
-    state, train_step, batch = _build(bs, image_size, arch, half=True,
-                                      fuse_views=True, ema_update_mode="post")
+    state, train_step, batch, _ = _build(bs, image_size, arch, half=True,
+                                         fuse_views=True,
+                                         ema_update_mode="post")
     for _ in range(3):                          # compile (cached) + warm
         state, metrics = train_step(state, batch)
     float(metrics["loss_mean"])
@@ -859,6 +977,260 @@ def _jpeg_tree_bench(threads=None):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _int_flag(name: str, default: int) -> int:
+    if name in sys.argv[1:]:
+        i = sys.argv.index(name) + 1
+        if i >= len(sys.argv):
+            raise SystemExit(f"usage: bench.py ... {name} <value>")
+        return int(sys.argv[i])
+    return default
+
+
+def _str_flag(name: str, default: str) -> str:
+    if name in sys.argv[1:]:
+        i = sys.argv.index(name) + 1
+        if i >= len(sys.argv):
+            raise SystemExit(f"usage: bench.py ... {name} <value>")
+        return sys.argv[i]
+    return default
+
+
+_V5E_HBM_BYTES = 16 * 2 ** 30            # the budget the ladder reports against
+
+
+def _abstract_batch(batch_size: int, image_size: int, mesh):
+    """ShapeDtypeStruct batch for compile-only paths: lowering needs shapes
+    and shardings, not 5 GB of host random pixels."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from byol_tpu.parallel.mesh import DATA_AXIS
+    sh = NamedSharding(mesh, P(DATA_AXIS))
+    b = batch_size
+    return {
+        "view1": jax.ShapeDtypeStruct((b, image_size, image_size, 3),
+                                      np.float32, sharding=sh),
+        "view2": jax.ShapeDtypeStruct((b, image_size, image_size, 3),
+                                      np.float32, sharding=sh),
+        "label": jax.ShapeDtypeStruct((b,), np.int32, sharding=sh),
+    }
+
+
+def _dry_compile(arch, image_size, on_tpu, attn_impl):
+    """AOT-compile ONE accumulation config and report memory_analysis()
+    without executing a step (``--dry-compile``).
+
+    Defaults to the paper-scale target: effective 4096 per chip at the
+    measured-optimal microbatch 256 (accum_steps 16) with the 'dots'
+    selective policy.  Prints one JSON line with compile_seconds + the HBM
+    high-water mark and whether it clears the v5e 16 GiB budget.  Also the
+    killable subprocess body behind the accumulation ladder's compile-
+    timeout gate (a wedged XLA compile dies with the subprocess — the
+    45-minute full-remat lesson).
+    """
+    eff = _int_flag("--effective-batch", 4096 if on_tpu else 64)
+    mb = _int_flag("--microbatch", 256 if on_tpu else 16)
+    policy = _str_flag("--remat-policy", "dots")
+    bn_mode = _str_flag("--accum-bn-mode", "average")
+    from byol_tpu.core.remat import validate_policy
+    validate_policy(policy)                  # fail fast on typos
+    if eff % mb:
+        raise SystemExit(
+            f"bench: effective batch {eff} not divisible by microbatch {mb}")
+    accum = eff // mb
+    # Same wiring as every measured rung (_build), but against an ABSTRACT
+    # batch (shapes + shardings): the compile-only path must not allocate
+    # effective-4096 of host pixels — and sharing _build keeps the gate's
+    # config from drifting away from the config the ladder then measures.
+    state, train_step, batch, mesh = _build(
+        eff, image_size, arch, half=True, fuse_views=True,
+        ema_update_mode="post", attn_impl=attn_impl, accum_steps=accum,
+        accum_bn_mode=bn_mode, remat_policy=policy, materialize_batch=False)
+    compiled, stats = _aot_compile(train_step, state, batch, mesh)
+    del compiled
+    hbm = stats.get("hbm_high_water_bytes")
+    print(json.dumps({
+        "metric": "dry_compile_hbm_high_water_bytes",
+        "value": hbm,
+        "unit": "bytes",
+        "vs_baseline": None,
+        "arch": arch, "image_size": image_size,
+        "effective_batch_per_chip": eff,
+        "microbatch_per_chip": mb,
+        "accum_steps": accum,
+        "remat_policy": policy,
+        "accum_bn_mode": bn_mode,
+        "device_kind": jax.devices()[0].device_kind,
+        "under_v5e_16gib": (None if hbm is None
+                            else bool(hbm < _V5E_HBM_BYTES)),
+        **stats,
+    }))
+
+
+def _accum_flags(on_tpu):
+    """Shared knob parsing for the accumulation ladder and its gate phase
+    (one source of truth: the gate children must compile exactly the rungs
+    the ladder then measures)."""
+    mb = _int_flag("--microbatch", 256 if on_tpu else 16)
+    policy = _str_flag("--remat-policy", "dots")
+    bn_mode = _str_flag("--accum-bn-mode", "average")
+    timeout = _int_flag("--compile-timeout", 900)
+    from byol_tpu.core.remat import validate_policy
+    validate_policy(policy)
+    # CPU fallback: ONE tiny rung — liveness, not measurement (a CPU "chip"
+    # sustains ~1 img/s on this model; a second rung would run for minutes).
+    effectives = [512, 1024, 4096] if on_tpu else [32]
+    return mb, policy, bn_mode, timeout, effectives
+
+
+def _probe_backend_is_accel(timeout_s: float = 180.0) -> bool:
+    """Is the default backend an accelerator — answered WITHOUT creating
+    the in-process client.  ``jax.default_backend()`` would claim the
+    single-client TPU for this process, and the accum-ladder gate children
+    must still be able to claim it after this returns."""
+    import subprocess
+    if str(jax.config.jax_platforms or "") == "cpu":
+        return False
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False
+    if probe.returncode != 0:
+        return False
+    out = probe.stdout.strip().splitlines()
+    return bool(out) and out[-1] != "cpu"
+
+
+def _accum_gate_phase(on_tpu, arch_override, attn_impl):
+    """Run every accumulation-ladder compile gate in a killable subprocess
+    BEFORE the parent initializes its own backend client.
+
+    Ordering is load-bearing on TPU: the backend is single-process-
+    exclusive (a second client hangs in backend init while any process
+    holds the chip — see the tpu_watch notes), so a gate child spawned
+    after the parent's client exists would hang until the timeout and
+    every rung would record a spurious wedged-compile signature.  Children
+    run strictly before and sequentially, each releasing the chip on exit
+    and leaving its compile in the persistent cache, which makes the
+    parent's measurement compile nearly free.
+
+    Returns ``{rung_name: {"status": "ok"|"timeout"|"error", ...}}`` for
+    :func:`_accum_ladder` to consume after the parent initializes.
+    """
+    import subprocess
+    mb, policy, bn_mode, timeout, effectives = _accum_flags(on_tpu)
+    gates = {}
+    for eff in effectives:
+        name = f"accum_eff{eff}_mb{mb}_{policy}"
+        gate_cmd = [sys.executable, os.path.abspath(__file__),
+                    "--dry-compile", "--effective-batch", str(eff),
+                    "--microbatch", str(mb), "--remat-policy", policy,
+                    "--accum-bn-mode", bn_mode, "--attn", attn_impl]
+        if arch_override:
+            # The gate must compile the SAME model the ladder measures: an
+            # un-forwarded --arch would wedge-protect the default arch
+            # while the parent compiled the overridden one unprotected.
+            gate_cmd += ["--arch", arch_override]
+        try:
+            gate = subprocess.run(gate_cmd, timeout=timeout,
+                                  capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            gates[name] = {"status": "timeout", "timeout": timeout}
+            print(f"bench: {name}: compile gate timed out after {timeout}s",
+                  file=sys.stderr)
+            continue
+        if gate.returncode != 0:
+            gates[name] = {"status": "error",
+                           "err": (gate.stderr or "").strip()[-300:]}
+            continue
+        try:
+            row = json.loads(gate.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            row = {}
+        gates[name] = {"status": "ok", "row": row}
+    return gates
+
+
+def _accum_ladder(arch, image_size, on_tpu, mfu_of, attn_impl, gates):
+    """Accumulation ladder (``--accum-ladder``): effective batch
+    512/1024/4096 per chip, ALL at the per-chip-optimal microbatch 256
+    (RESULTS.md §1: bs256 is the throughput peak; bs512 spills; bs1024
+    OOMs un-rematted).
+
+    Every rung's compile already ran in a killable subprocess
+    (``--dry-compile`` body, :func:`_accum_gate_phase`, BEFORE this
+    process claimed the backend) under ``--compile-timeout`` seconds —
+    the compile-timeout gate: a wedged XLA compile (the 45-minute
+    full-remat incident) is killed without taking the process or the
+    remote-compile service down, and the rung records ``fit=False`` with
+    a timeout signature.  On a clean gate pass this function measures
+    throughput in-process; the persistent compile cache makes the second
+    compile nearly free.  Rows record compile_seconds,
+    hbm_high_water_bytes, and img/s/chip.
+    """
+    mb, policy, bn_mode, timeout, effectives = _accum_flags(on_tpu)
+    timing_steps = 10 if on_tpu else 3
+    rungs = []
+    for eff in effectives:
+        if _backend_dead:
+            break
+        accum = eff // mb
+        name = f"accum_eff{eff}_mb{mb}_{policy}"
+        gate = gates.get(name) or {"status": "error",
+                                   "err": "no gate result for this rung"}
+        if gate["status"] == "timeout":
+            _record(name, fit=False, effective_batch_per_chip=eff,
+                    microbatch_per_chip=mb, accum_steps=accum,
+                    remat_policy=policy,
+                    error=f"compile-timeout gate: exceeded {timeout}s "
+                          "(wedged-compile signature; subprocess killed)")
+            continue
+        if gate["status"] == "error":
+            err = gate["err"]
+            if _config_failed(f"accum gate {name}", RuntimeError(err)):
+                break
+            _record(name, fit=False, effective_batch_per_chip=eff,
+                    microbatch_per_chip=mb, accum_steps=accum,
+                    remat_policy=policy, error=f"gate subprocess: {err}")
+            continue
+        gate_row = gate.get("row", {})
+        try:
+            val = _throughput(eff, image_size, arch, half=True,
+                              fuse_views=True, ema_update_mode="post",
+                              attn_impl=attn_impl, accum_steps=accum,
+                              accum_bn_mode=bn_mode, remat_policy=policy,
+                              steps=timing_steps)
+        except Exception as e:
+            if _config_failed(f"accum ladder {name}", e):
+                break
+            _record(name, fit=False, effective_batch_per_chip=eff,
+                    microbatch_per_chip=mb, accum_steps=accum,
+                    remat_policy=policy, error=repr(e)[:300],
+                    gate_hbm_high_water_bytes=gate_row.get(
+                        "hbm_high_water_bytes"))
+            continue
+        row = {"effective_batch_per_chip": eff, "microbatch_per_chip": mb,
+               "accum_steps": accum, "remat_policy": policy,
+               "accum_bn_mode": bn_mode,
+               "images_per_sec_per_chip": round(val, 2),
+               "mfu": mfu_of(val), **_row_stats(val)}
+        if "hbm_high_water_bytes" not in row and gate_row:
+            row["hbm_high_water_bytes"] = gate_row.get(
+                "hbm_high_water_bytes")
+        rungs.append(row)
+        _record(name, fit=True, **row)
+        print(f"bench: {name}: {float(val):.1f} img/s/chip "
+              f"compile={row.get('compile_seconds')}s "
+              f"hbm={row.get('hbm_high_water_bytes')}", file=sys.stderr)
+    print(json.dumps({"metric": "accum_ladder", "value": len(rungs),
+                      "unit": "rungs", "vs_baseline": None,
+                      "microbatch_per_chip": mb, "remat_policy": policy,
+                      "rungs": rungs,
+                      "complete": not _backend_dead}))
+    if _backend_dead:
+        raise SystemExit(3)   # same truncation contract as --sweep
+
+
 def _sweep_prior_rows() -> dict:
     """Sweep rows measured by a previous, interrupted attempt.
 
@@ -955,7 +1327,7 @@ def _sweep(arch, image_size, candidates, mfu_of):
                "images_per_sec_per_chip": round(val, 2),
                "mfu": mfu_of(val)}
         rows.append(row)
-        _record(name, fit=True, **row)
+        _record(name, fit=True, **row, **_row_stats(val))
         print(f"bench: {name}: {val:.1f} img/s/chip "
               f"mfu={row['mfu']}", file=sys.stderr)
     # CPU-fallback tables must not shadow the committed TPU table, an early

@@ -158,7 +158,37 @@ def build_parser() -> argparse.ArgumentParser:
                    help="one fused encoder call for both views (perf; "
                         "changes BN batch statistics vs the reference)")
     x.add_argument("--remat", action="store_true",
-                   help="checkpoint the encoder (HBM for FLOPs)")
+                   help="legacy all-or-nothing per-block checkpoint "
+                        "(= --remat-policy full); prefer a selective policy")
+    x.add_argument("--remat-policy", type=str, default="none",
+                   choices=("none", "full", "nothing", "dots",
+                            "dots_no_batch", "save_block_out",
+                            "offload_block_out"),
+                   help="selective rematerialization policy per "
+                        "residual/encoder block (core/remat.py): 'dots' "
+                        "saves conv/matmul results and recomputes the "
+                        "cheap chains between them — the recommended "
+                        "HBM-for-FLOPs trade; 'save_block_out'/"
+                        "'offload_block_out' keep only tagged block "
+                        "outputs (the latter in pinned host memory)")
+    x.add_argument("--accum-steps", type=int, default=1,
+                   help="microbatched gradient accumulation: split each "
+                        "global batch into this many microbatches inside "
+                        "the jitted step (lax.scan), one optimizer update "
+                        "+ EMA tick per global batch.  --batch-size stays "
+                        "the EFFECTIVE batch; LR schedule / EMA tau / "
+                        "counters see optimizer steps.  Breaks the HBM "
+                        "spill wall: any effective batch runs at the "
+                        "per-chip-optimal microbatch.  1 = off")
+    x.add_argument("--accum-bn-mode", type=str, default="average",
+                   choices=("average", "microbatch", "global"),
+                   help="BN-statistics granularity under accumulation: "
+                        "'average' = per-microbatch normalization, one "
+                        "running-stat tick per step from averaged stats; "
+                        "'microbatch' = k sequential ticks; 'global' = "
+                        "exact big-batch semantics via cross-microbatch "
+                        "stat sync (semantics oracle — costs the "
+                        "big-batch memory back)")
     x.add_argument("--stem", type=str, default="conv",
                    choices=("conv", "space_to_depth"),
                    help="resnet stem: space_to_depth computes the 7x7/2 "
@@ -216,6 +246,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             weight_initialization=args.weight_initialization,
             model_dir=args.model_dir,
             fuse_views=args.fuse_views, remat=args.remat,
+            remat_policy=args.remat_policy,
             stem=args.stem,
             attn_impl=args.attn_impl, pooling=args.pooling),
         regularizer=RegularizerConfig(
@@ -228,7 +259,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
             clip=args.clip, lr=args.lr,
             lr_update_schedule=args.lr_update_schedule,
             warmup=args.warmup, optimizer=args.optimizer,
-            early_stop=args.early_stop),
+            early_stop=args.early_stop,
+            accum_steps=args.accum_steps,
+            accum_bn_mode=args.accum_bn_mode),
         device=DeviceConfig(
             num_replicas=n_rep,
             workers_per_replica=args.workers_per_replica,

@@ -76,8 +76,15 @@ class ModelConfig:
                                         # batch statistics vs the reference's
                                         # per-view forwards (main.py:244-247),
                                         # so off by default; turn on for perf.
-    remat: bool = False                 # jax.checkpoint the encoder to trade
-                                        # FLOPs for HBM.
+    remat: bool = False                 # legacy all-or-nothing jax.checkpoint
+                                        # of every encoder block (= policy
+                                        # 'full'); kept for back-compat.
+    remat_policy: str = "none"          # named SELECTIVE checkpoint policy
+                                        # (core/remat.py POLICY_NAMES:
+                                        # none|full|nothing|dots|
+                                        # dots_no_batch|save_block_out|
+                                        # offload_block_out); wins over the
+                                        # bool when not 'none'.
     stem: str = "conv"                  # resnet stem: 'conv' (7x7/2) or
                                         # 'space_to_depth' (identical numerics,
                                         # MXU-friendly 4x4/1 rearrangement).
@@ -114,6 +121,28 @@ class OptimConfig:
     warmup: int = 10                    # warmup epochs (ref main.py:87)
     optimizer: str = "lars_momentum"    # registry key; 'lars_' prefix composes
     early_stop: bool = False
+    # Microbatched gradient accumulation: split each global batch into
+    # accum_steps microbatches inside the jitted step (lax.scan), accumulate
+    # gradients, and apply ONE optimizer update + EMA tick.  The LR schedule,
+    # step counters, EMA tau, and throughput accounting all see OPTIMIZER
+    # steps — batch_size stays the EFFECTIVE global batch.  1 = off.
+    accum_steps: int = 1
+    # BN-statistics granularity under accumulation (per-microbatch
+    # normalization is inherent to one-pass accumulation; this knob controls
+    # how running stats tick and offers an exact-semantics oracle):
+    # - 'average'    (default): normalize per microbatch; ONE running-stat
+    #                tick per optimizer step using the microbatch-averaged
+    #                statistics (big-batch tick granularity).
+    # - 'microbatch': normalize per microbatch; k sequential running-stat
+    #                ticks (the semantics of k small steps between updates).
+    # - 'global'    : EXACT big-batch semantics — microbatches run under a
+    #                vmapped named axis and every BatchNorm syncs statistics
+    #                across it (SyncBN over microbatches), so normalization,
+    #                gradients, and the single running-stat tick all match
+    #                one batch-(k*m) step to fp tolerance.  Costs the
+    #                big-batch memory back (all microbatches in flight):
+    #                a semantics oracle for parity tests, not an HBM saver.
+    accum_bn_mode: str = "average"
 
 
 @_frozen
@@ -218,6 +247,16 @@ class ResolvedConfig:
     def global_batch_size(self) -> int:
         return self.cfg.task.batch_size
 
+    @property
+    def accum_steps(self) -> int:
+        return self.cfg.optim.accum_steps
+
+    @property
+    def microbatch_size(self) -> int:
+        """GLOBAL microbatch size: the batch each accumulation scan
+        iteration forwards (= effective batch when accumulation is off)."""
+        return self.cfg.task.batch_size // self.cfg.optim.accum_steps
+
 
 def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
             output_size: int, input_shape: Tuple[int, int, int],
@@ -239,6 +278,21 @@ def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
         raise ValueError(
             f"global batch {cfg.task.batch_size} not divisible by "
             f"num_replicas {n_rep}")
+    accum = cfg.optim.accum_steps
+    if accum < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum}")
+    if cfg.task.batch_size % (accum * n_rep) != 0:
+        # each scan iteration must shard its microbatch over the data axis
+        # without resharding: n_rep | (batch / accum)
+        raise ValueError(
+            f"global batch {cfg.task.batch_size} not divisible by "
+            f"accum_steps x num_replicas = {accum} x {n_rep}")
+    if cfg.optim.accum_bn_mode not in ("average", "microbatch", "global"):
+        raise ValueError(
+            f"unknown accum_bn_mode {cfg.optim.accum_bn_mode!r}; "
+            "'average' | 'microbatch' | 'global'")
+    from byol_tpu.core.remat import resolve_policy_name
+    resolve_policy_name(cfg.model.remat, cfg.model.remat_policy)  # fail fast
     per_replica_batch = cfg.task.batch_size // n_rep
     per_replica_train = num_train_samples // n_rep
     steps_per_epoch = per_replica_train // per_replica_batch

@@ -1,0 +1,128 @@
+"""Named selective rematerialization policies.
+
+The all-or-nothing per-block ``nn.remat`` (``--remat``) saves nothing but
+block inputs, so the backward pass re-runs every conv in a block — and the
+resulting backward graph wedged XLA's compiler for 45+ minutes at the bs1024
+rung (RESULTS.md §1 outage history).  Selective policies keep the expensive
+tensors (MXU outputs: conv / dot results) and recompute only the cheap
+elementwise/normalization chains between them, which both bounds the FLOPs
+overhead (<~30% for conv nets) and keeps the backward HLO close enough to
+the un-rematted graph that compile times stay sane.
+
+Policy names (``--remat-policy``, ``core.config.ModelConfig.remat_policy``):
+
+- ``none``          : no rematerialization (policy plumbing inert).
+- ``full``          : per-block ``nn.remat`` with the default save-nothing
+                      behavior — the legacy ``--remat`` flag, kept for
+                      comparison; known compile hazard at large batch.
+- ``nothing``       : explicit ``nothing_saveable`` policy (same residual
+                      footprint as ``full``, spelled as a policy so it goes
+                      through the same code path as the selective ones).
+- ``dots``          : ``dots_saveable`` — save every conv/matmul result,
+                      recompute elementwise/BN/activation chains.  The
+                      recommended default for ResNet/ViT under microbatch
+                      accumulation.
+- ``dots_no_batch`` : ``dots_with_no_batch_dims_saveable`` — save only
+                      contractions with no batch dims (weight-gradient
+                      style); leaner than ``dots``, more recompute.
+- ``save_block_out``: save ONLY the tensors tagged ``block_out`` (each
+                      residual-block / encoder-block output,
+                      ``checkpoint_name`` tags in models/resnet.py and
+                      models/vit.py); everything inside a block is
+                      recomputed.  The minimal-HBM non-offloading policy.
+- ``offload_block_out``: as ``save_block_out`` but the tagged block outputs
+                      are offloaded to pinned host memory instead of held
+                      in HBM (``save_and_offload_only_these_names``).
+                      Requires a backend with pinned-host support; validate
+                      with :func:`validate_policy` before building.
+
+Models apply a policy per residual/encoder block via :func:`wrap_block`, so
+the checkpoint boundary is the block — the granularity the stage/block
+``checkpoint_name`` tags are designed around.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+
+# The tag models put on every residual/encoder block output (see
+# models/resnet.py and models/vit.py).  Offloadable / save-only policies key
+# on this name.
+BLOCK_OUT = "block_out"
+
+POLICY_NAMES = ("none", "full", "nothing", "dots", "dots_no_batch",
+                "save_block_out", "offload_block_out")
+
+
+def checkpoint_policy(name: str) -> Optional[Callable[..., Any]]:
+    """Resolve a policy name to a ``jax.checkpoint`` policy callable.
+
+    ``none`` and ``full`` return None (no policy argument: ``none`` means no
+    remat at all; ``full`` means remat with the default save-nothing rule).
+    """
+    cp = jax.checkpoint_policies
+    if name in ("none", "full"):
+        return None
+    if name == "nothing":
+        return cp.nothing_saveable
+    if name == "dots":
+        return cp.dots_saveable
+    if name == "dots_no_batch":
+        return cp.dots_with_no_batch_dims_saveable
+    if name == "save_block_out":
+        return cp.save_only_these_names(BLOCK_OUT)
+    if name == "offload_block_out":
+        return cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=[BLOCK_OUT],
+            offload_src="device", offload_dst="pinned_host")
+    raise ValueError(
+        f"unknown remat policy {name!r}; known: {POLICY_NAMES}")
+
+
+def validate_policy(name: str) -> str:
+    """Fail fast on typos (the --arch/--attn lesson from bench.py: a bad
+    knob must not surface as every ladder rung 'failing to fit')."""
+    if name not in POLICY_NAMES:
+        raise ValueError(
+            f"unknown remat policy {name!r}; known: {POLICY_NAMES}")
+    return name
+
+
+def wrap_block(block_cls, policy_name: str):
+    """Wrap a flax Module class in ``nn.remat`` per the named policy.
+
+    ``none`` returns the class untouched; ``full`` is plain ``nn.remat``
+    (save nothing); every other name attaches the selective policy.
+    """
+    validate_policy(policy_name)
+    if policy_name == "none":
+        return block_cls
+    policy = checkpoint_policy(policy_name)
+    if policy is None:
+        return nn.remat(block_cls)
+    return nn.remat(block_cls, policy=policy)
+
+
+def resolve_policy_name(remat: bool, remat_policy: str) -> str:
+    """Merge the legacy ``--remat`` bool with the named-policy knob.
+
+    The bool is kept as a back-compat alias for ``full``; an explicit
+    policy name wins over it.
+    """
+    validate_policy(remat_policy)
+    if remat_policy != "none":
+        return remat_policy
+    return "full" if remat else "none"
+
+
+def tag_block_out(x):
+    """Tag a block output so named policies can save/offload it.
+
+    A no-op unless a surrounding ``jax.checkpoint`` uses a names-based
+    policy; safe (identity) everywhere else, including eval and init.
+    """
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(x, BLOCK_OUT)

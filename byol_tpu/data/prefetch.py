@@ -1,35 +1,98 @@
 """Device prefetch: double-buffer host batches into HBM.
 
 The DALI/`prefetch_to_device` analog (SURVEY.md §2.4): while the TPU runs
-step N, the next host batch is already being transferred, so the MXU never
-waits on PCIe/host.  Works with any iterator of numpy pytrees; placement uses
-the mesh ``data``-axis sharding so each device receives only its shard.
+step N, the next host batch is already being produced AND transferred, so
+the MXU never waits on the host.  Works with any iterator of numpy pytrees;
+placement uses the mesh ``data``-axis sharding so each device receives only
+its shard.
+
+Production runs on a BACKGROUND THREAD: the original implementation called
+``next(iterator)`` synchronously in the consumer loop, so the host-side
+augment/decode work (tf.data graph or the C++ pipeline — plus the
+range-check/tap generators the trainer stacks on top) blocked the dispatch
+thread between steps.  On a 1-core TPU host that serialization is the whole
+ballgame: with production moved off-thread, augment/decode for batch N+1
+overlaps both the device compute of batch N and its H2D transfer (numpy /
+tf / device_put all release the GIL during the heavy parts).
+
+Contract kept from the synchronous version:
+- yields device-resident batches in exactly the iterator's order;
+- at most ``size`` batches are in flight beyond the one being consumed;
+- an exception raised by the source iterator (e.g. the trainer's [0,1]
+  range check) propagates to the consumer — after the batches produced
+  before it, exactly where the synchronous version would have raised;
+- closing the generator (``break`` / ``.close()``) stops the producer
+  thread promptly and joins it — no daemon-thread leaks into the next
+  epoch's iterator.
 """
 from __future__ import annotations
 
-import collections
+import queue
+import threading
 from typing import Iterator
 
-import jax
 from jax.sharding import Mesh
 
 from byol_tpu.parallel.mesh import shard_batch_to_mesh
 
+_END = object()          # producer sentinel: source iterator exhausted
+
+
+class _Failure:
+    """Carries a producer-side exception across the queue."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
 
 def prefetch_to_mesh(iterator: Iterator, mesh: Mesh, size: int = 2
                      ) -> Iterator:
-    """Yield device-resident batches, keeping ``size`` in flight."""
-    queue = collections.deque()
+    """Yield device-resident batches, keeping up to ``size`` in flight."""
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    # ``slots`` — not the queue's maxsize — is what bounds device residency:
+    # each of the ``size`` slots covers one device-resident batch beyond the
+    # consumed one, and the producer RESERVES its slot before device_put.
+    # (Sharding first and then blocking on a bounded queue would pin a
+    # size+1'th batch in HBM — ~1.2 GB/batch at effective-4096@224, on
+    # exactly the memory-wall configs accumulation exists to fit.)
+    q: "queue.Queue" = queue.Queue()
+    slots = threading.Semaphore(size)
+    stop = threading.Event()
 
-    def enqueue(n):
-        for _ in range(n):
-            batch = next(iterator, None)
-            if batch is None:
+    def produce():
+        try:
+            for batch in iterator:
+                # Slot acquisition that notices consumer shutdown: a plain
+                # blocking acquire would deadlock the join below if the
+                # consumer left while all slots were held.
+                while not slots.acquire(timeout=0.1):
+                    if stop.is_set():
+                        return
+                if stop.is_set():
+                    return
+                q.put(shard_batch_to_mesh(batch, mesh))
+            item = _END
+        except BaseException as e:   # noqa: BLE001 — relayed, not dropped
+            item = _Failure(e)
+        # Sentinels bypass the slots (they hold no device memory) and the
+        # queue is unbounded, so this put never blocks.
+        q.put(item)
+
+    thread = threading.Thread(target=produce, name="prefetch_to_mesh",
+                              daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
                 return
-            queue.append(shard_batch_to_mesh(batch, mesh))
-
-    enqueue(size)
-    while queue:
-        out = queue.popleft()
-        enqueue(1)
-        yield out
+            if isinstance(item, _Failure):
+                raise item.exc
+            # This batch is now "the one being consumed": free its slot so
+            # the producer can stage the next one.
+            slots.release()
+            yield item
+    finally:
+        stop.set()
+        thread.join(timeout=5.0)

@@ -29,14 +29,21 @@ class BYOLNet(nn.Module):
     head_latent_size: int = 4096       # --head-latent-size (main.py:63-64)
     projection_size: int = 256         # --projection-size (main.py:61-62)
     dtype: jnp.dtype = jnp.float32
+    # named axis the head BNs sync statistics over (accum_bn_mode='global');
+    # the backbone gets its own copy of the knob at construction
+    bn_axis_name: Optional[str] = None
 
     def setup(self):
         self.projector = MLPHead(hidden_size=self.head_latent_size,
                                  output_size=self.projection_size,
-                                 dtype=self.dtype, name="projector")
+                                 dtype=self.dtype,
+                                 bn_axis_name=self.bn_axis_name,
+                                 name="projector")
         self.predictor = MLPHead(hidden_size=self.head_latent_size,
                                  output_size=self.projection_size,
-                                 dtype=self.dtype, name="predictor")
+                                 dtype=self.dtype,
+                                 bn_axis_name=self.bn_axis_name,
+                                 name="predictor")
         self.probe = LinearProbe(num_classes=self.num_classes,
                                  dtype=self.dtype, name="probe")
 
@@ -65,10 +72,17 @@ class BYOLNet(nn.Module):
 
 def build_byol_net(arch: str, *, num_classes: int, head_latent_size: int,
                    projection_size: int, dtype=jnp.float32,
-                   small_inputs: bool = False, **backbone_kwargs) -> "BYOLNet":
-    from byol_tpu.models.registry import get_backbone
+                   small_inputs: bool = False,
+                   bn_axis_name: Optional[str] = None,
+                   **backbone_kwargs) -> "BYOLNet":
+    from byol_tpu.models.registry import get_backbone, get_spec
+    if get_spec(arch).has_batchnorm:
+        # BN-free backbones (ViT) have no stats to sync; only pass the axis
+        # where a BatchNorm exists to consume it.
+        backbone_kwargs = dict(backbone_kwargs, bn_axis_name=bn_axis_name)
     backbone, _ = get_backbone(arch, dtype=dtype, small_inputs=small_inputs,
                                **backbone_kwargs)
     return BYOLNet(backbone=backbone, num_classes=num_classes,
                    head_latent_size=head_latent_size,
-                   projection_size=projection_size, dtype=dtype)
+                   projection_size=projection_size, dtype=dtype,
+                   bn_axis_name=bn_axis_name)

@@ -17,12 +17,16 @@ class MLPHead(nn.Module):
     output_size: int = 256
     dtype: jnp.dtype = jnp.float32
     bn_momentum: float = 0.9
+    # named axis for BN statistics (the accum_bn_mode='global' vmap axis);
+    # None = statistics over the (locally visible) batch only
+    bn_axis_name: "str | None" = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         x = nn.Dense(self.hidden_size, dtype=self.dtype, name="dense1")(x)
         x = nn.BatchNorm(use_running_average=not train,
-                         momentum=self.bn_momentum, name="bn")(x)
+                         momentum=self.bn_momentum,
+                         axis_name=self.bn_axis_name, name="bn")(x)
         x = nn.relu(x)
         x = nn.Dense(self.output_size, dtype=self.dtype, name="dense2")(x)
         return x.astype(self.dtype)

@@ -23,6 +23,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from byol_tpu.core import remat as remat_lib
+
 ModuleDef = Any
 
 
@@ -93,7 +95,7 @@ class BasicBlock(nn.Module):
             residual = self.conv(self.filters, (1, 1), self.strides,
                                  name="downsample_conv")(residual)
             residual = self.norm(name="downsample_bn")(residual)
-        return nn.relu(y + residual)
+        return remat_lib.tag_block_out(nn.relu(y + residual))
 
 
 class Bottleneck(nn.Module):
@@ -137,7 +139,7 @@ class Bottleneck(nn.Module):
             residual = self.conv(out_filters, (1, 1), self.strides,
                                  name="downsample_conv")(residual)
             residual = self.norm(name="downsample_bn")(residual)
-        return nn.relu(y + residual)
+        return remat_lib.tag_block_out(nn.relu(y + residual))
 
 
 class ResNet(nn.Module):
@@ -151,15 +153,19 @@ class ResNet(nn.Module):
     bn_epsilon: float = 1e-5
     small_inputs: bool = False           # CIFAR stem: 3x3/1, no max-pool
     zero_init_residual: bool = True      # False = torchvision/reference init
-    remat: bool = False                  # jax.checkpoint each residual block
-                                         # (recompute activations in backward:
-                                         # HBM for FLOPs)
+    remat: bool = False                  # legacy alias for remat_policy='full'
+    remat_policy: str = "none"           # named selective checkpoint policy
+                                         # (core/remat.py POLICY_NAMES);
+                                         # wins over the bool when not 'none'
     stem: str = "conv"                   # 'conv' | 'space_to_depth' (identical
                                          # numerics, MXU-friendly layout;
                                          # ignored for the CIFAR stem)
     inner_multiplier: int = 1            # torchvision wide_resnet*_2: widen
                                          # only the bottleneck inner convs
                                          # (feature dim unchanged)
+    bn_axis_name: Optional[str] = None   # named axis for BN statistics (the
+                                         # accum_bn_mode='global' vmap axis;
+                                         # SyncBN-over-microbatches)
 
     @property
     def feature_dim(self) -> int:
@@ -175,7 +181,8 @@ class ResNet(nn.Module):
         # fp32" rule (SURVEY.md §2.4) by construction.
         norm = functools.partial(nn.BatchNorm, use_running_average=not train,
                                  momentum=self.bn_momentum,
-                                 epsilon=self.bn_epsilon)
+                                 epsilon=self.bn_epsilon,
+                                 axis_name=self.bn_axis_name)
         if self.small_inputs:
             x = conv(self.width, (3, 3), padding=1, name="stem_conv")(x)
         elif self.stem == "space_to_depth":
@@ -190,7 +197,9 @@ class ResNet(nn.Module):
         x = nn.relu(x)
         if not self.small_inputs:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
-        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
+        block_cls = remat_lib.wrap_block(
+            self.block_cls,
+            remat_lib.resolve_policy_name(self.remat, self.remat_policy))
         # BasicBlock has no inner width to widen; only pass the knob where
         # it exists (wide variants are bottleneck-only, as in torchvision)
         wide_kw = ({"inner_multiplier": self.inner_multiplier}
@@ -221,7 +230,9 @@ BASIC = {"resnet18", "resnet34"}
 def make_resnet(name: str, *, dtype=jnp.float32, width_multiplier: int = 1,
                 small_inputs: bool = False,
                 zero_init_residual: bool = True,
-                remat: bool = False, stem: str = "conv") -> ResNet:
+                remat: bool = False, remat_policy: str = "none",
+                stem: str = "conv",
+                bn_axis_name: Optional[str] = None) -> ResNet:
     """Two widening conventions, both first-class:
 
     - ``resnetNNw2`` (paper-style "x2", the BYOL paper's RN50(2x)): EVERY
@@ -250,5 +261,6 @@ def make_resnet(name: str, *, dtype=jnp.float32, width_multiplier: int = 1,
                   width=64 * width_multiplier, dtype=dtype,
                   small_inputs=small_inputs,
                   zero_init_residual=zero_init_residual,
-                  remat=remat, stem=stem,
-                  inner_multiplier=inner_multiplier)
+                  remat=remat, remat_policy=remat_policy, stem=stem,
+                  inner_multiplier=inner_multiplier,
+                  bn_axis_name=bn_axis_name)

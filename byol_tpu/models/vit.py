@@ -27,6 +27,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from byol_tpu.core import remat as remat_lib
 from byol_tpu.ops.attention import get_attention_fn
 
 
@@ -77,7 +78,7 @@ class EncoderBlock(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         x = x + MlpBlock(self.mlp_ratio * x.shape[-1], self.dtype,
                          name="mlp")(y)
-        return x
+        return remat_lib.tag_block_out(x)
 
 
 class ViT(nn.Module):
@@ -91,7 +92,9 @@ class ViT(nn.Module):
     dtype: jnp.dtype = jnp.float32
     pooling: str = "cls"                 # 'cls' | 'gap'
     attn_impl: str = "dense"
-    remat: bool = False
+    remat: bool = False                  # legacy alias for remat_policy='full'
+    remat_policy: str = "none"           # named selective checkpoint policy
+                                         # (core/remat.py POLICY_NAMES)
 
     @property
     def feature_dim(self) -> int:
@@ -124,9 +127,9 @@ class ViT(nn.Module):
                          (1, s, self.width), jnp.float32)
         x = x + pos.astype(self.dtype)
 
-        block = EncoderBlock
-        if self.remat:
-            block = nn.remat(EncoderBlock)
+        block = remat_lib.wrap_block(
+            EncoderBlock,
+            remat_lib.resolve_policy_name(self.remat, self.remat_policy))
         for i in range(self.depth):
             x = block(num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                       dtype=self.dtype, attn_impl=self.attn_impl,

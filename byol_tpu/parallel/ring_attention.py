@@ -91,10 +91,14 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
             f"sequence length {q.shape[2]} not divisible by sequence-"
             f"parallel size {sp}")
     spec = P(DATA_AXIS, None, SEQUENCE_AXIS, None)
-    fn = jax.shard_map(
-        functools.partial(ring_attention_local, axis_name=SEQUENCE_AXIS),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+    body = functools.partial(ring_attention_local, axis_name=SEQUENCE_AXIS)
+    if hasattr(jax, "shard_map"):           # jax >= 0.5
+        fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+    else:                                    # jax 0.4.x spelling
+        from jax.experimental.shard_map import shard_map
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
     return fn(q, k, v)
 
 

@@ -37,11 +37,20 @@ def build_net(rcfg: ResolvedConfig) -> BYOLNet:
     if get_spec(cfg.model.arch).has_batchnorm:
         extra = {"zero_init_residual": cfg.parity.zero_init_residual,
                  "remat": cfg.model.remat,
+                 "remat_policy": cfg.model.remat_policy,
                  "stem": cfg.model.stem}
     else:  # ViT-family knobs
         extra = {"remat": cfg.model.remat,
+                 "remat_policy": cfg.model.remat_policy,
                  "attn_impl": cfg.model.attn_impl,
                  "pooling": cfg.model.pooling}
+    # accum_bn_mode='global': every BatchNorm (backbone + MLP heads) syncs
+    # statistics over the vmapped microbatch axis inside the train step, so
+    # normalization spans the EFFECTIVE batch exactly as one big step would.
+    from byol_tpu.training.steps import ACCUM_AXIS
+    bn_axis = (ACCUM_AXIS
+               if (cfg.optim.accum_steps > 1
+                   and cfg.optim.accum_bn_mode == "global") else None)
     return build_byol_net(
         cfg.model.arch,
         num_classes=rcfg.output_size,
@@ -49,6 +58,7 @@ def build_net(rcfg: ResolvedConfig) -> BYOLNet:
         projection_size=cfg.model.projection_size,
         dtype=policy.compute_dtype,
         small_inputs=small,
+        bn_axis_name=bn_axis,
         **extra)
 
 
@@ -59,6 +69,16 @@ def init_variables(net: BYOLNet, rcfg: ResolvedConfig, rng: jax.Array,
     the mesh."""
     h, w, c = rcfg.input_shape
     dummy = jnp.zeros((batch, h, w, c), jnp.float32)
+    axis = getattr(net, "bn_axis_name", None)
+    if axis:
+        # BN modules pmean over the accumulation axis; init's train-mode
+        # warmup forward must run with that axis BOUND.  A size-1 vmap binds
+        # it without changing any statistic (pmean over 1 = identity).
+        variables = jax.vmap(
+            lambda d: net.init({"params": rng}, d, train=True,
+                               method="warmup"),
+            axis_name=axis)(dummy[None])
+        return jax.tree_util.tree_map(lambda x: x[0], variables)
     return net.init({"params": rng}, dummy, train=True, method="warmup")
 
 
@@ -102,7 +122,9 @@ def step_config(rcfg: ResolvedConfig) -> StepConfig:
         norm_mode=cfg.parity.loss_norm_mode,
         fuse_views=cfg.model.fuse_views,
         polyak_ema=polyak,
-        ema_update_mode=cfg.parity.ema_update_mode)
+        ema_update_mode=cfg.parity.ema_update_mode,
+        accum_steps=cfg.optim.accum_steps,
+        accum_bn_mode=cfg.optim.accum_bn_mode)
 
 
 def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array

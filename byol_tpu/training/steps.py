@@ -24,6 +24,32 @@ Semantics deltas from the reference, both deliberate and documented:
   forward (main.py:255, before optimizer.step()); the paper (and default
   here) EMAs the POST-update params.  ``ema_update_mode='reference_pre'``
   reproduces the reference.
+
+Microbatched gradient accumulation (``accum_steps > 1``): the effective
+batch is split into ``accum_steps`` microbatches INSIDE the jitted step and
+scanned (``lax.scan``), with ``jax.grad`` applied per microbatch — so the
+backward residuals of only ONE microbatch are ever live, which is what
+breaks the HBM spill wall (RESULTS.md §1: bs512 spills, bs1024 OOMs).
+Gradients and loss metrics are mean-accumulated with equal microbatch
+weights (exactly the big-batch mean), then ONE optimizer update + ONE EMA
+tick runs — counters, LR schedule, and EMA tau all see optimizer steps.
+Semantics match a single batch-(k*m) step up to BN-statistics granularity,
+controlled by ``accum_bn_mode``:
+
+- ``average`` (default): per-microbatch normalization; one running-stat tick
+  per optimizer step using the microbatch-averaged batch statistics.
+- ``microbatch``: per-microbatch normalization; k sequential running-stat
+  ticks (the semantics of k small steps between updates).
+- ``global``: EXACT big-batch semantics — microbatches run under a vmapped
+  named axis (``ACCUM_AXIS``) and every BatchNorm syncs its statistics
+  across it, so normalization, gradients (AD through the psum), and the
+  single running-stat tick reproduce the monolithic step to fp tolerance.
+  No memory savings (all microbatches in flight): a semantics oracle.
+
+The microbatch partition is STRIDED (microbatch i takes rows i, i+k, ...),
+which keeps the reshape device-local under the GSPMD batch sharding — no
+resharding collectives.  Batch order is i.i.d. so the partition choice is
+semantically free.
 """
 from __future__ import annotations
 
@@ -42,6 +68,12 @@ from byol_tpu.optim.schedules import cosine_ema_decay
 from byol_tpu.training.state import TrainState
 
 
+# Named axis microbatches are vmapped over in accum_bn_mode='global'; BN
+# modules receive it as bn_axis_name (build.py) and pmean their statistics
+# across it.
+ACCUM_AXIS = "accum"
+
+
 @dataclasses.dataclass(frozen=True)
 class StepConfig:
     total_train_steps: int
@@ -50,6 +82,8 @@ class StepConfig:
     fuse_views: bool = False
     polyak_ema: float = 0.0
     ema_update_mode: str = "post"        # 'post' | 'reference_pre'
+    accum_steps: int = 1                 # microbatches per optimizer step
+    accum_bn_mode: str = "average"       # 'average'|'microbatch'|'global'
 
 
 def _forward_views(net, params, batch_stats, aug1, aug2, *, train: bool,
@@ -84,6 +118,23 @@ def _forward_views(net, params, batch_stats, aug1, aug2, *, train: bool,
     return out1, out2, bs
 
 
+def _microbatch_split(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """``(B, ...) -> (k, B//k, ...)``: microbatch i takes rows i, i+k, ...
+
+    The strided partition is deliberate: reshaping ``(B,)`` to ``(B//k, k)``
+    splits the GSPMD-sharded batch dim with the sharded factor MAJOR, so
+    each device reshapes/transposes only its own contiguous shard — no
+    cross-device resharding, unlike the contiguous ``(k, B//k)`` reshape
+    (whose microbatches would straddle device boundaries).  Which rows land
+    in which microbatch is semantically free (i.i.d. batch).
+    """
+    b = x.shape[0]
+    if b % k:
+        raise ValueError(f"batch {b} not divisible by accum_steps {k}")
+    x = x.reshape((b // k, k) + x.shape[1:])
+    return jnp.swapaxes(x, 0, 1)
+
+
 def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
                     policy: Policy = FP32
                     ) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
@@ -92,24 +143,36 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
 
     ``batch`` = {'view1': (B,H,W,C), 'view2': (B,H,W,C), 'label': (B,)},
     pixels in [0,1] (the reference input contract, main.py:486-490).
+    B is the EFFECTIVE batch; with ``accum_steps`` k > 1 it is split into k
+    microbatches inside the step (module docstring).
     """
+    if scfg.accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {scfg.accum_steps}")
+    if scfg.accum_bn_mode not in ("average", "microbatch", "global"):
+        raise ValueError(
+            f"unknown accum_bn_mode {scfg.accum_bn_mode!r}; "
+            "'average' | 'microbatch' | 'global'")
 
-    def train_step(state: TrainState, batch):
-        aug1 = policy.cast_to_compute(batch["view1"])
-        aug2 = policy.cast_to_compute(batch["view2"])
-        labels = batch["label"]
+    def micro_grads(params, target_params, batch_stats, view1, view2,
+                    labels):
+        """Gradients + new BN stats + metrics for ONE microbatch (= the
+        whole batch when accumulation is off).  The dtype cast happens here
+        so accumulation never materializes a full-effective-batch bf16 copy
+        — only the live microbatch is cast."""
+        aug1 = policy.cast_to_compute(view1)
+        aug2 = policy.cast_to_compute(view2)
 
         # Target branch: outside the differentiated function — autodiff never
         # sees it (vs reference building + detaching the graph, Quirk Q10).
         tgt1, tgt2, _ = _forward_views(
-            net, state.target_params, state.batch_stats, aug1, aug2,
+            net, target_params, batch_stats, aug1, aug2,
             train=True, fuse=scfg.fuse_views, update_stats=False)
         target_proj1 = jax.lax.stop_gradient(tgt1["projection"])
         target_proj2 = jax.lax.stop_gradient(tgt2["projection"])
 
         def loss_fn(params):
             on1, on2, new_bs = _forward_views(
-                net, params, state.batch_stats, aug1, aug2,
+                net, params, batch_stats, aug1, aug2,
                 train=True, fuse=scfg.fuse_views, update_stats=True)
             byol_loss = loss_function(
                 on1["prediction"], on2["prediction"],
@@ -132,8 +195,83 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
             return total, (new_bs, metrics)
 
         grads, (new_bs, metrics) = jax.grad(
-            loss_fn, has_aux=True)(state.params)
-        grads = policy.cast_to_param(grads)
+            loss_fn, has_aux=True)(params)
+        return policy.cast_to_param(grads), new_bs, metrics
+
+    def accumulate_scan(state: TrainState, views1, views2, labels):
+        """'average' / 'microbatch' modes: lax.scan over microbatches with
+        jax.grad INSIDE the body, so only one microbatch's backward
+        residuals are live at a time (the HBM win)."""
+        k = scfg.accum_steps
+        sequential_bn = scfg.accum_bn_mode == "microbatch"
+        # Abstract eval gives the carry structure without running anything.
+        g_shape, bs_shape, m_shape = jax.eval_shape(
+            micro_grads, state.params, state.target_params,
+            state.batch_stats, views1[0], views2[0], labels[0])
+        zeros = lambda shapes: jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+        def body(carry, xs):
+            grad_sum, bs_acc, metric_sum = carry
+            v1, v2, lbl = xs
+            # 'microbatch': thread running stats through the scan (k ticks);
+            # 'average': every microbatch ticks from the step's input stats,
+            # and the tick results are averaged afterwards (one effective
+            # tick with microbatch-averaged batch statistics).
+            bs_in = bs_acc if sequential_bn else state.batch_stats
+            g, new_bs, m = micro_grads(state.params, state.target_params,
+                                       bs_in, v1, v2, lbl)
+            add = lambda a, b: jax.tree_util.tree_map(jnp.add, a, b)
+            grad_sum = add(grad_sum, g)
+            bs_acc = new_bs if sequential_bn else add(bs_acc, new_bs)
+            metric_sum = add(metric_sum, m)
+            return (grad_sum, bs_acc, metric_sum), None
+
+        init = (zeros(g_shape),
+                state.batch_stats if sequential_bn else zeros(bs_shape),
+                zeros(m_shape))
+        (grad_sum, bs_acc, metric_sum), _ = jax.lax.scan(
+            body, init, (views1, views2, labels))
+        mean = lambda t: jax.tree_util.tree_map(
+            lambda x: (x / k).astype(x.dtype), t)
+        # Equal-size microbatches: the mean over microbatch means IS the
+        # effective-batch mean, for gradients and metrics alike.
+        new_bs = bs_acc if sequential_bn else mean(bs_acc)
+        return mean(grad_sum), new_bs, mean(metric_sum)
+
+    def accumulate_global(state: TrainState, views1, views2, labels):
+        """'global' mode: vmap over microbatches with ACCUM_AXIS bound, so
+        every BatchNorm pmeans its statistics across the whole effective
+        batch and AD through the psum recovers the exact big-batch gradient
+        (mean over instances).  All microbatches are in flight — exact
+        semantics, no memory savings."""
+        grads_k, bs_k, metrics_k = jax.vmap(
+            micro_grads, in_axes=(None, None, None, 0, 0, 0),
+            axis_name=ACCUM_AXIS)(
+                state.params, state.target_params, state.batch_stats,
+                views1, views2, labels)
+        mean0 = lambda t: jax.tree_util.tree_map(
+            lambda x: jnp.mean(x, axis=0).astype(x.dtype), t)
+        # Statistics are synced across the axis, so every instance computed
+        # the identical running-stat tick: take instance 0.
+        new_bs = jax.tree_util.tree_map(lambda x: x[0], bs_k)
+        return mean0(grads_k), new_bs, mean0(metrics_k)
+
+    def train_step(state: TrainState, batch):
+        labels = batch["label"]
+        if scfg.accum_steps == 1:
+            grads, new_bs, metrics = micro_grads(
+                state.params, state.target_params, state.batch_stats,
+                batch["view1"], batch["view2"], labels)
+        else:
+            views1 = _microbatch_split(batch["view1"], scfg.accum_steps)
+            views2 = _microbatch_split(batch["view2"], scfg.accum_steps)
+            mlabels = _microbatch_split(labels, scfg.accum_steps)
+            accumulate = (accumulate_global
+                          if scfg.accum_bn_mode == "global"
+                          else accumulate_scan)
+            grads, new_bs, metrics = accumulate(state, views1, views2,
+                                                mlabels)
 
         updates, new_opt_state = tx.update(grads, state.opt_state,
                                            state.params)

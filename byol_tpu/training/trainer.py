@@ -111,6 +111,15 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         print(f"model: {cfg.model.arch}, "
               f"{number_of_parameters(state.params) / 1e6:.2f}M params "
               f"(main.py:447-449 analog)")
+        if rcfg.accum_steps > 1:
+            # Accumulation happens INSIDE the jitted step: every count in
+            # this loop (state.step, steps_per_train_epoch, the LR schedule
+            # argument, EMA tau, throughput per effective batch) is an
+            # OPTIMIZER step — microbatches are invisible above steps.py.
+            print(f"grad accumulation: {rcfg.accum_steps} microbatches of "
+                  f"{rcfg.microbatch_size} (global) per optimizer step, "
+                  f"bn_mode={cfg.optim.accum_bn_mode}, effective batch "
+                  f"{rcfg.global_batch_size}")
 
     name = run_name(cfg)
     if grapher is None:

@@ -19,7 +19,13 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.4.38) has no jax_num_cpu_devices; the XLA_FLAGS
+    # device-count flag set above does the same job as long as the backend
+    # is still uninitialized here (it is: sitecustomize only IMPORTS jax).
+    pass
 # Persistent compilation cache: repeated test runs (and repeated fit() calls
 # within one run) reuse compiled executables instead of paying 30-60s XLA
 # compiles per jit instance.

@@ -139,7 +139,7 @@ class TestFlopsAccounting:
     def test_cost_analysis_matches_hand_table(self):
         import bench
         from byol_tpu.observability import flops as fl
-        state, train_step, batch = bench._build(
+        state, train_step, batch, _ = bench._build(
             8, 32, "resnet18", half=False, fuse_views=True,
             ema_update_mode="post")
         got = fl.cost_analysis_flops(train_step, state, batch)
