@@ -210,6 +210,22 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("copy", "reference"), help="Quirk Q1 switch")
     x.add_argument("--schedule-granularity", type=str, default="step",
                    choices=("step", "epoch"), help="Quirk Q5 switch")
+    x.add_argument("--ema-update-mode", type=str, default="post",
+                   choices=("post", "reference_pre"),
+                   help="'post' = paper (EMA of post-update params); "
+                        "'reference_pre' = reference (EMAs pre-update "
+                        "params inside forward, main.py:255)")
+    x.add_argument("--normalize-inputs",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="Quirk Q3 switch: standardize pixels with the "
+                        "ImageNet mean/std inside the jitted step (the "
+                        "paper recipe; the reference feeds raw [0,1] "
+                        "pixels)")
+    x.add_argument("--zero-init-residual",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="zero-init each residual block's last BN scale "
+                        "(large-batch trick); --no-zero-init-residual "
+                        "matches torchvision/reference init (main.py:436)")
     x.add_argument("--profile-port", type=int, default=0,
                    help="start jax.profiler server on this port (0=off)")
     x.add_argument("--linear-eval", action="store_true",
@@ -281,7 +297,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
         parity=ParityConfig(
             loss_norm_mode=args.loss_norm_mode,
             ema_init_mode=args.ema_init_mode,
-            schedule_granularity=args.schedule_granularity),
+            schedule_granularity=args.schedule_granularity,
+            normalize_inputs=args.normalize_inputs,
+            ema_update_mode=args.ema_update_mode,
+            zero_init_residual=args.zero_init_residual),
     )
 
 

@@ -55,6 +55,15 @@ BLOCK_OUT = "block_out"
 POLICY_NAMES = ("none", "full", "nothing", "dots", "dots_no_batch",
                 "save_block_out", "offload_block_out")
 
+# Policies that key on checkpoint_name tags: if the traced graph carries no
+# tag, these silently degrade to save-nothing — the exact backward graph
+# that wedged XLA for 45 minutes at the bs1024 rung.
+NAMES_BASED_POLICIES = ("save_block_out", "offload_block_out")
+
+
+class RematTagError(ValueError):
+    """A names-based remat policy matched zero checkpoint_name tags."""
+
 
 def checkpoint_policy(name: str) -> Optional[Callable[..., Any]]:
     """Resolve a policy name to a ``jax.checkpoint`` policy callable.
@@ -126,3 +135,52 @@ def tag_block_out(x):
     """
     from jax.ad_checkpoint import checkpoint_name
     return checkpoint_name(x, BLOCK_OUT)
+
+
+def _collect_tags(jaxpr, tags: set) -> None:
+    """Gather every ``checkpoint_name`` tag in a jaxpr, recursing into
+    sub-jaxprs (remat bodies, scan/cond/pjit/custom-vjp closures)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "name":
+            tags.add(eqn.params.get("name"))
+        for val in eqn.params.values():
+            for sub in (val if isinstance(val, (list, tuple)) else (val,)):
+                inner = getattr(sub, "jaxpr", sub)
+                if hasattr(inner, "eqns"):
+                    _collect_tags(inner, tags)
+
+
+def tags_in_trace(fn, *args, **kwargs) -> set:
+    """The set of ``checkpoint_name`` tags ``fn``'s traced graph carries.
+
+    Abstract trace only (``jax.make_jaxpr``): no compile, no execution —
+    cheap enough to run at setup time on CPU.
+    """
+    closed = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+    tags: set = set()
+    _collect_tags(closed.jaxpr, tags)
+    return tags
+
+
+def assert_tags_in_trace(fn, *args, policy_name: str, **kwargs) -> set:
+    """Runtime complement to graphlint's GL105: raise :class:`RematTagError`
+    when a names-based policy would match zero tags in ``fn``'s traced
+    graph (instead of silently saving nothing).
+
+    No-op (returns an empty set without tracing) for policies that do not
+    key on tags.  The AST rule catches statically-visible drift; this
+    catches models assembled dynamically, where the linter cannot see the
+    block class.
+    """
+    if policy_name not in NAMES_BASED_POLICIES:
+        return set()
+    tags = tags_in_trace(fn, *args, **kwargs)
+    if BLOCK_OUT not in tags:
+        raise RematTagError(
+            f"remat policy {policy_name!r} keys on checkpoint_name tag "
+            f"{BLOCK_OUT!r}, but the traced graph carries no such tag "
+            f"(found: {sorted(t for t in tags if t) or 'none'}). The "
+            "policy would silently save NOTHING — the save-nothing "
+            "backward graph is the known XLA compile hazard. A model "
+            "block probably lost its tag_block_out call.")
+    return tags

@@ -124,7 +124,38 @@ def step_config(rcfg: ResolvedConfig) -> StepConfig:
         polyak_ema=polyak,
         ema_update_mode=cfg.parity.ema_update_mode,
         accum_steps=cfg.optim.accum_steps,
-        accum_bn_mode=cfg.optim.accum_bn_mode)
+        accum_bn_mode=cfg.optim.accum_bn_mode,
+        normalize_inputs=cfg.parity.normalize_inputs)
+
+
+def _validate_remat_tags(net, rcfg: ResolvedConfig, variables,
+                         batch: int) -> None:
+    """Runtime complement to graphlint GL105: a names-based remat policy
+    must match at least one ``checkpoint_name`` tag in the traced forward,
+    or core/remat.py raises instead of silently saving nothing."""
+    from byol_tpu.core import remat as remat_lib
+    cfg = rcfg.cfg
+    policy_name = remat_lib.resolve_policy_name(cfg.model.remat,
+                                                cfg.model.remat_policy)
+    if policy_name not in remat_lib.NAMES_BASED_POLICIES:
+        return
+    h, w, c = rcfg.input_shape
+    dummy = jnp.zeros((batch, h, w, c), jnp.float32)
+    axis = getattr(net, "bn_axis_name", None)
+
+    def fwd(v, d):
+        return net.apply(v, d, train=True, method="warmup",
+                         mutable=["batch_stats"])
+
+    if axis:
+        # same size-1 vmap trick as init_variables: BN pmeans need the
+        # accumulation axis bound during the trace
+        fn = lambda v, d: jax.vmap(lambda dd: fwd(v, dd),
+                                   axis_name=axis)(d[None])
+    else:
+        fn = fwd
+    remat_lib.assert_tags_in_trace(fn, variables, dummy,
+                                   policy_name=policy_name)
 
 
 def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array
@@ -142,6 +173,8 @@ def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array
     with mesh:
         variables = init_variables(
             net, rcfg, keys["params"], batch=max(2, mesh.shape[DATA_AXIS]))
+        _validate_remat_tags(net, rcfg, variables,
+                             batch=max(2, mesh.shape[DATA_AXIS]))
         if cfg.model.weight_initialization:
             # --weight-initialization scheme re-draw (main.py:436 analog)
             from byol_tpu.models.init import apply_weight_init

@@ -41,6 +41,16 @@ import optax
 
 from byol_tpu.objectives.metrics import topk_accuracy
 from byol_tpu.parallel.lockstep import all_status
+from byol_tpu.training.steps import normalize_images
+
+
+def _prep_inputs(x, policy, normalize: bool):
+    """The trained input contract, shared by both frozen-encoder
+    extractors: cast to the trained compute dtype and (Quirk Q3,
+    ``normalize_inputs``) re-apply the same ImageNet standardization the
+    train step used — eval features must see the trained distribution."""
+    xc = policy.cast_to_compute(x)
+    return normalize_images(xc) if normalize else xc
 
 
 @dataclasses.dataclass
@@ -76,8 +86,8 @@ def extract_features(apply_fn: Callable, batches: Iterator[Dict[str, Any]],
     return np.concatenate(feats), np.concatenate(labels)
 
 
-def encoder_extractor_spmd(net, state, mesh, *, half: bool = False
-                           ) -> Callable:
+def encoder_extractor_spmd(net, state, mesh, *, half: bool = False,
+                           normalize: bool = False) -> Callable:
     """SPMD frozen-encoder extractor: ``(x, y, mask)`` global arrays in,
     REPLICATED ``(features_fp32, y, mask)`` out — the replicated
     out_shardings is the cross-host all-gather, so every host can read the
@@ -92,7 +102,7 @@ def encoder_extractor_spmd(net, state, mesh, *, half: bool = False
     def apply(x, y, mask):
         out = net.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
-            policy.cast_to_compute(x), train=False, mutable=False)
+            _prep_inputs(x, policy, normalize), train=False, mutable=False)
         return out["representation"].astype(jnp.float32), y, mask
 
     return apply
@@ -272,7 +282,8 @@ def linear_eval(apply_fn: Callable, train_batches: Iterator,
                          epochs=epochs, lr=lr, seed=seed)
 
 
-def encoder_apply_fn(net, state, *, half: bool = False) -> Callable:
+def encoder_apply_fn(net, state, *, half: bool = False,
+                     normalize: bool = False) -> Callable:
     """Jitted frozen-encoder feature extractor from a TrainState."""
     from byol_tpu.core.precision import get_policy
     policy = get_policy(half)
@@ -281,7 +292,7 @@ def encoder_apply_fn(net, state, *, half: bool = False) -> Callable:
     def apply(x):
         out = net.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
-            policy.cast_to_compute(x), train=False, mutable=False)
+            _prep_inputs(x, policy, normalize), train=False, mutable=False)
         return out["representation"].astype(jnp.float32)
 
     return apply
@@ -314,13 +325,15 @@ def run_linear_eval_from_cfg(cfg, state, *, loader=None, mesh=None,
             raise ValueError(
                 "multi-host linear eval needs the training mesh "
                 "(pass mesh=FitResult.mesh)")
-        apply_fn = encoder_apply_fn(net, state, half=cfg.device.half)
+        apply_fn = encoder_apply_fn(net, state, half=cfg.device.half,
+                                    normalize=cfg.parity.normalize_inputs)
         return linear_eval(apply_fn, loader.train_eval_loader,
                            loader.test_loader, loader.output_size,
                            epochs=epochs, seed=seed)
     host_batch = rcfg.global_batch_size // jax.process_count()
     apply_fn = encoder_extractor_spmd(net, state, mesh,
-                                      half=cfg.device.half)
+                                      half=cfg.device.half,
+                                      normalize=cfg.parity.normalize_inputs)
     train_x, train_y = extract_features_spmd(
         apply_fn, loader.train_eval_loader, mesh, host_batch=host_batch,
         sample_shape=loader.input_shape)

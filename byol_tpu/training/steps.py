@@ -73,6 +73,25 @@ from byol_tpu.training.state import TrainState
 # across it.
 ACCUM_AXIS = "accum"
 
+# ImageNet channel statistics (torchvision convention) behind the
+# ``normalize_inputs`` parity switch (Quirk Q3: the reference feeds raw
+# [0,1] pixels; the BYOL paper standardizes its inputs).
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def normalize_images(x: jnp.ndarray) -> jnp.ndarray:
+    """Standardize NHWC [0,1] pixels with the ImageNet mean/std.
+
+    Non-RGB inputs (grayscale tasks) use the channel-averaged statistics so
+    the switch stays usable on every task the loader serves.
+    """
+    mean = jnp.asarray(IMAGENET_MEAN, x.dtype)
+    std = jnp.asarray(IMAGENET_STD, x.dtype)
+    if x.shape[-1] != len(IMAGENET_MEAN):
+        mean, std = jnp.mean(mean), jnp.mean(std)
+    return (x - mean) / std
+
 
 @dataclasses.dataclass(frozen=True)
 class StepConfig:
@@ -84,6 +103,8 @@ class StepConfig:
     ema_update_mode: str = "post"        # 'post' | 'reference_pre'
     accum_steps: int = 1                 # microbatches per optimizer step
     accum_bn_mode: str = "average"       # 'average'|'microbatch'|'global'
+    normalize_inputs: bool = False       # Quirk Q3: ImageNet mean/std
+                                         # standardization inside the step
 
 
 def _forward_views(net, params, batch_stats, aug1, aug2, *, train: bool,
@@ -161,6 +182,8 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
         — only the live microbatch is cast."""
         aug1 = policy.cast_to_compute(view1)
         aug2 = policy.cast_to_compute(view2)
+        if scfg.normalize_inputs:
+            aug1, aug2 = normalize_images(aug1), normalize_images(aug2)
 
         # Target branch: outside the differentiated function — autodiff never
         # sees it (vs reference building + detaching the graph, Quirk Q10).
@@ -316,6 +339,8 @@ def make_eval_step(net, scfg: StepConfig, policy: Policy = FP32):
     def eval_step(state: TrainState, batch):
         aug1 = policy.cast_to_compute(batch["view1"])
         aug2 = policy.cast_to_compute(batch["view2"])
+        if scfg.normalize_inputs:
+            aug1, aug2 = normalize_images(aug1), normalize_images(aug2)
         labels = batch["label"]
         # Optional validity mask for pad+mask eval batching: the trainer pads
         # the final (non-divisible) test batch to the fixed batch shape so

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Static-analysis gate: graphlint over the shipped byol_tpu/ tree.
+#
+# Default run (no args) produces both outputs from ONE engine run:
+#   - human text on stdout (findings as path:line:col: RULE message);
+#   - machine JSON at evidence/graphlint.json (schema in
+#     tools/graphlint/reporters.py), committed so rule-count trends are
+#     diffable across PRs.
+#
+# Extra args (e.g. `scripts/lint.sh --select GL103`) pass through but
+# SKIP the evidence write — a partial-rule sweep must never overwrite
+# the committed full-sweep trend file.
+#
+# Exit: 0 clean, 1 findings, 2 usage error — same contract as
+# `python -m tools.graphlint`.  Tier-1 shells the same entrypoint
+# (tests/test_graphlint.py::TestTreeGate), so DOTS_PASSED gates the lint
+# even where this script never runs.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+# pure-AST tool: force the cheap backend so an axon/TPU session env can
+# never make a lint hang on accelerator init
+export JAX_PLATFORMS=cpu
+
+if [ "$#" -eq 0 ]; then
+    mkdir -p evidence
+    exec python -m tools.graphlint byol_tpu/ --out evidence/graphlint.json
+fi
+exec python -m tools.graphlint byol_tpu/ "$@"

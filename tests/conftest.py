@@ -33,7 +33,36 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_compile_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
+import functools  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+def guard_steps(fn):
+    """Runtime complement to graphlint GL101/GL102: wrap a jitted step so
+    every call (including the first, tracing+compiling one) runs under
+
+    - ``jax.transfer_guard("disallow")`` — an IMPLICIT host<->device
+      transfer inside the step (a ``float()``/``np.asarray`` sync point, a
+      numpy constant smuggled into the traced graph) fails the test on CPU
+      instead of stalling a TPU run.  Explicit transfers (``device_put``,
+      ``device_get``) stay allowed — reading metrics AFTER the call is
+      legitimate and must be spelled explicitly.
+    - ``jax.checking_leaks()`` — a tracer escaping the traced scope (the
+      classic closure-capture bug) raises instead of baking in a constant.
+    """
+    @functools.wraps(fn)
+    def guarded(*args, **kwargs):
+        with jax.transfer_guard("disallow"), jax.checking_leaks():
+            return fn(*args, **kwargs)
+    return guarded
+
+
+@pytest.fixture(scope="session")
+def step_guard():
+    """Fixture handle for :func:`guard_steps` (importable directly as
+    ``tests.conftest.guard_steps`` where a fixture is awkward)."""
+    return guard_steps
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,20 @@
+"""GL106 fixture: dead config knob + unread flag (must fire)."""
+import argparse
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftyCfg:
+    lr: float = 0.1
+    momentum: float = 0.9        # no builder passes it: dead knob
+
+
+def build_parser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--drifty-ghost", type=int, default=0)  # never read
+    return p
+
+
+def config_from_args(args):
+    return DriftyCfg(lr=args.lr)

@@ -1,0 +1,15 @@
+"""GL104 fixture: use-after-donate (must fire)."""
+import jax
+
+
+def step_fn(state, batch):
+    return state, {}
+
+
+train_step = jax.jit(step_fn, donate_argnums=(0,))
+
+
+def loop(state, batches):
+    for batch in batches:
+        new_state, metrics = train_step(state, batch)  # donates, no rebind
+    return state                                       # reads a dead buffer

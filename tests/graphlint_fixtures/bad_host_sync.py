@@ -1,0 +1,21 @@
+"""GL101 fixture: host-device sync points inside traced code (must fire)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    y = jnp.sum(x)
+    host = np.asarray(y)          # numpy materialization of a traced value
+    fetched = jax.device_get(y)   # device->host transfer by definition
+    return float(y) + host.mean() + fetched
+
+
+def scan_body(carry, x):
+    val = jnp.dot(carry, x)
+    return carry, val.item()      # .item() blocks on a readback
+
+
+def run(carry, xs):
+    return jax.lax.scan(scan_body, carry, xs)

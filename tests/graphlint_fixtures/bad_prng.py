@@ -1,0 +1,8 @@
+"""GL103 fixture: PRNG key consumed twice (must fire)."""
+import jax
+
+
+def sample(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))   # same key again: identical randomness
+    return a + b

@@ -1,0 +1,30 @@
+"""GL102 fixture: recompile hazards (must fire)."""
+import jax
+import jax.numpy as jnp
+
+
+def fn(x, cfg):
+    return x
+
+
+def run_all(fns, xs):
+    outs = []
+    for f in fns:
+        outs.append(jax.jit(f)(xs))     # fresh wrapper + empty cache per iter
+    return outs
+
+
+step = jax.jit(fn, static_argnums=(1,))
+
+
+def call_with_unhashable(x):
+    return step(x, [1, 2, 3])           # list in a static position
+
+
+def make_step(scale):
+    w = jnp.ones((3,)) * scale          # outer-scope array local
+
+    @jax.jit
+    def inner(z):
+        return z + w                    # baked in as a compile-time constant
+    return inner

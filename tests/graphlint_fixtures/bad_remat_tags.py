@@ -1,0 +1,16 @@
+"""GL105 fixture: names-based policy over an untagged block (must fire)."""
+import flax.linen as nn
+import jax
+
+UNTAGGED_POLICY = jax.checkpoint_policies.save_only_these_names(
+    "fixture_block_out")
+
+
+class UntaggedBlock(nn.Module):
+    def __call__(self, x):
+        return x * 2.0              # no checkpoint_name tag: policy saves
+                                    # NOTHING, silently
+
+
+def build():
+    return nn.remat(UntaggedBlock, policy=UNTAGGED_POLICY)

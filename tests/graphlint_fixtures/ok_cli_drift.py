@@ -1,0 +1,20 @@
+"""GL106 near-miss: every field settable, every flag consumed (clean)."""
+import argparse
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class TidyCfg:
+    lr: float = 0.1
+    momentum: float = 0.9
+
+
+def build_parser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    return p
+
+
+def config_from_args(args):
+    return TidyCfg(lr=args.lr, momentum=args.momentum)

@@ -1,0 +1,16 @@
+"""GL101 near-miss: shape arithmetic and host code outside traces (clean)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    b = x.shape[0]
+    scale = np.sqrt(b)            # numpy on a STATIC shape value: fine
+    return x * float(scale)       # float() of a non-array: fine
+
+
+def epoch_metrics(metrics):
+    # host-side readback OUTSIDE any traced scope is legitimate
+    return {k: float(np.asarray(v)) for k, v in metrics.items()}

@@ -1,0 +1,22 @@
+"""GL103 near-miss: split / fold_in / per-iteration rebind (clean)."""
+import jax
+
+
+def sample(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return a + b
+
+
+def per_step(key, step):
+    k = jax.random.fold_in(key, step)   # derivation, not reuse
+    return jax.random.normal(k, (4,))
+
+
+def rolling(key, n):
+    outs = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)   # rebound every iteration
+        outs.append(jax.random.normal(sub, (4,)))
+    return outs

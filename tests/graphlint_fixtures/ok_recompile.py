@@ -1,0 +1,36 @@
+"""GL102 near-miss: hoisted jit, hashable statics, inner locals (clean)."""
+import jax
+import jax.numpy as jnp
+
+
+def fn(x, cfg):
+    return x
+
+
+step = jax.jit(fn, static_argnums=(1,))
+
+
+def run_all(batches):
+    outs = []
+    for b in batches:                   # jit built ONCE, called in the loop
+        outs.append(step(b, (1, 2, 3)))  # tuple static: hashable and stable
+    return outs
+
+
+def make_step(scale):
+    @jax.jit
+    def inner(z):
+        y = jnp.ones((3,)) * scale      # inner's OWN local, not a capture
+        return z + y
+    return inner
+
+
+def make_other(x):
+    def sibling():
+        arr = jnp.zeros((2,))           # a SIBLING scope's local
+        return arr
+
+    @jax.jit
+    def inner(z):
+        return z * 2.0                  # touches neither w nor arr
+    return inner(x), sibling()

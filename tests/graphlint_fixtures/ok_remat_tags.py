@@ -1,0 +1,16 @@
+"""GL105 near-miss: tagged block matching the declared policy name (clean)."""
+import flax.linen as nn
+import jax
+from jax.ad_checkpoint import checkpoint_name
+
+GOOD_POLICY = jax.checkpoint_policies.save_only_these_names(
+    "fixture_good_out")
+
+
+class TaggedBlock(nn.Module):
+    def __call__(self, x):
+        return checkpoint_name(x * 2.0, "fixture_good_out")
+
+
+def build():
+    return nn.remat(TaggedBlock, policy=GOOD_POLICY)

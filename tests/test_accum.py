@@ -25,6 +25,7 @@ import pytest
 from byol_tpu.core import config as config_lib
 from byol_tpu.parallel.mesh import shard_batch_to_mesh
 from byol_tpu.training.build import setup_training
+from tests.conftest import guard_steps
 
 BATCH = 32
 
@@ -56,9 +57,13 @@ def make_batch(seed=0):
 
 
 def run_steps(rcfg, mesh, n=3):
-    """n train steps from the seed-0 init; returns (final state, metrics)."""
+    """n train steps from the seed-0 init; returns (final state, metrics).
+
+    Steps run under guard_steps (conftest.py): an implicit host transfer or
+    tracer leak inside the accumulation scan fails tier-1 here, on CPU."""
     net, state, train_step, _, _ = setup_training(
         rcfg, mesh, jax.random.PRNGKey(0))
+    train_step = guard_steps(train_step)
     metrics = None
     for i in range(n):
         batch = shard_batch_to_mesh(make_batch(seed=i), mesh)
@@ -103,6 +108,7 @@ class TestAccumulationParity:
         rcfg = tiny_config(accum_steps=4, accum_bn_mode=bn_mode)
         net, state, train_step, _, _ = setup_training(
             rcfg, mesh8, jax.random.PRNGKey(0))
+        train_step = guard_steps(train_step)
         # device_get is zero-copy on CPU and the jitted step DONATES the
         # state, so the buffer is overwritten in place — snapshot by copy.
         bs_before = jax.tree_util.tree_map(
@@ -197,6 +203,36 @@ class TestRematPolicies:
         from byol_tpu.core.remat import POLICY_NAMES, checkpoint_policy
         for name in POLICY_NAMES:
             checkpoint_policy(name)   # no typo'd jax attribute lookups
+
+    def test_names_policy_rejects_untagged_graph(self):
+        """Runtime complement to graphlint GL105: a names-based policy over
+        a graph with NO checkpoint_name tags must raise (it would silently
+        save nothing — the known compile hazard), while tagged graphs and
+        non-names policies pass.  The build path runs this check in
+        setup_training, so test_policy_is_numerically_inert also exercises
+        it end-to-end with the real ResNet."""
+        from byol_tpu.core import remat
+
+        def untagged(x):
+            return x * 2.0
+
+        def tagged(x):
+            return remat.tag_block_out(x * 2.0)
+
+        x = jnp.ones((4,))
+        assert remat.BLOCK_OUT in remat.tags_in_trace(tagged, x)
+        with pytest.raises(remat.RematTagError, match="save_block_out"):
+            remat.assert_tags_in_trace(untagged, x,
+                                       policy_name="save_block_out")
+        with pytest.raises(remat.RematTagError, match="offload_block_out"):
+            remat.assert_tags_in_trace(untagged, x,
+                                       policy_name="offload_block_out")
+        # non-names policies don't key on tags: no trace, no error
+        assert remat.assert_tags_in_trace(
+            untagged, x, policy_name="dots") == set()
+        # tagged graph under a names policy: validated, tags returned
+        assert remat.BLOCK_OUT in remat.assert_tags_in_trace(
+            tagged, x, policy_name="save_block_out")
 
 
 class TestThreadedPrefetch:

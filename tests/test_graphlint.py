@@ -1,0 +1,221 @@
+"""graphlint self-tests: rule corpus, suppressions, reporters, tree gate.
+
+Three layers:
+
+1. **Rule corpus** (tests/graphlint_fixtures/): one deliberately-bugged
+   snippet per rule (must fire) and one near-miss per rule (must stay
+   clean) — the false-positive contract that lets the tree gate demand
+   ZERO findings rather than "few".
+2. **Engine semantics**: suppression comments (justified ones suppress,
+   unjustified ones become GL001 findings), syntax errors (GL000), JSON
+   reporter shape.
+3. **Tree gate**: ``python -m tools.graphlint byol_tpu/`` exits 0 — this
+   pytest IS the CI wiring (ROADMAP tier-1 DOTS_PASSED gates the lint);
+   scripts/lint.sh shells the same entrypoint for humans.
+
+The linter is pure-AST (never imports the code under analysis), so these
+tests run in milliseconds with no jax/TPU initialization.
+"""
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from tools.graphlint import engine
+from tools.graphlint.reporters import json_report
+from tools.graphlint.rules import all_rules
+
+FIXTURES = pathlib.Path(__file__).resolve().parent / "graphlint_fixtures"
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# (rule id, must-fire fixture, must-stay-clean fixture)
+RULE_CASES = [
+    ("GL101", "bad_host_sync.py", "ok_host_sync.py"),
+    ("GL102", "bad_recompile.py", "ok_recompile.py"),
+    ("GL103", "bad_prng.py", "ok_prng.py"),
+    ("GL104", "bad_donate.py", "ok_donate.py"),
+    ("GL105", "bad_remat_tags.py", "ok_remat_tags.py"),
+    ("GL106", "bad_cli_drift.py", "ok_cli_drift.py"),
+]
+
+
+def run_rule(path, rule_id):
+    findings, _ = engine.run([str(path)], all_rules(), select={rule_id})
+    return [f for f in findings if f.rule == rule_id]
+
+
+class TestRuleCorpus:
+    @pytest.mark.parametrize("rule_id,bad,ok", RULE_CASES)
+    def test_bugged_snippet_triggers(self, rule_id, bad, ok):
+        findings = run_rule(FIXTURES / bad, rule_id)
+        assert findings, f"{rule_id} must fire on {bad}"
+
+    @pytest.mark.parametrize("rule_id,bad,ok", RULE_CASES)
+    def test_near_miss_stays_clean(self, rule_id, bad, ok):
+        findings = run_rule(FIXTURES / ok, rule_id)
+        assert findings == [], (
+            f"{rule_id} false positive on {ok}: "
+            + "; ".join(f.message for f in findings))
+
+    def test_corpus_reports_all_rule_ids_and_exits_nonzero(self):
+        """Acceptance: the bugged corpus trips EVERY rule through the real
+        CLI entrypoint, with a non-zero exit."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graphlint", str(FIXTURES),
+             "--format", "json"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        payload = json.loads(proc.stdout)
+        for rule_id, _, _ in RULE_CASES:
+            assert payload["counts_by_rule"].get(rule_id, 0) > 0, (
+                f"{rule_id} missing from corpus sweep: "
+                f"{payload['counts_by_rule']}")
+        assert payload["clean"] is False
+
+
+class TestEngineSemantics:
+    def test_justified_suppression_suppresses(self, tmp_path):
+        src = ("import jax\n\n\ndef f(key):\n"
+               "    a = jax.random.normal(key, (2,))\n"
+               "    b = jax.random.normal(key, (2,))"
+               "  # graphlint: disable=GL103 -- fixture: reuse is the test\n"
+               "    return a + b\n")
+        p = tmp_path / "sup.py"
+        p.write_text(src)
+        findings, _ = engine.run([str(p)], all_rules())
+        assert findings == []
+
+    def test_unjustified_suppression_is_gl001(self, tmp_path):
+        src = ("import jax\n\n\ndef f(key):\n"
+               "    a = jax.random.normal(key, (2,))\n"
+               "    b = jax.random.normal(key, (2,))"
+               "  # graphlint: disable=GL103\n"
+               "    return a + b\n")
+        p = tmp_path / "sup.py"
+        p.write_text(src)
+        findings, _ = engine.run([str(p)], all_rules())
+        assert [f.rule for f in findings] == [engine.UNJUSTIFIED]
+
+    def test_suppression_on_comment_line_covers_next_line(self, tmp_path):
+        src = ("import jax\n\n\ndef f(key):\n"
+               "    a = jax.random.normal(key, (2,))\n"
+               "    # graphlint: disable=GL103 -- fixture: suppress-above\n"
+               "    b = jax.random.normal(key, (2,))\n"
+               "    return a + b\n")
+        p = tmp_path / "sup.py"
+        p.write_text(src)
+        findings, _ = engine.run([str(p)], all_rules())
+        assert findings == []
+
+    def test_suppression_covers_only_named_rule(self, tmp_path):
+        src = ("import jax\n\n\ndef f(key):\n"
+               "    a = jax.random.normal(key, (2,))\n"
+               "    b = jax.random.normal(key, (2,))"
+               "  # graphlint: disable=GL101 -- wrong rule named\n"
+               "    return a + b\n")
+        p = tmp_path / "sup.py"
+        p.write_text(src)
+        findings, _ = engine.run([str(p)], all_rules())
+        assert "GL103" in {f.rule for f in findings}
+
+    def test_suppression_text_inside_string_is_inert(self, tmp_path):
+        """Suppression-like text in a docstring/string (a usage example)
+        must neither emit GL001 nor suppress real findings — comments are
+        found via tokenize, not a regex over raw source lines."""
+        src = ('"""Example:\n'
+               "    val = float(x)  # graphlint: disable=GL101\n"
+               '"""\n'
+               "import jax\n\n\ndef f(key):\n"
+               "    msg = 'x  # graphlint: disable=GL103 -- not a comment'\n"
+               "    a = jax.random.normal(key, (2,))\n"
+               "    b = jax.random.normal(key, (2,))\n"
+               "    return a + b, msg\n")
+        p = tmp_path / "doc.py"
+        p.write_text(src)
+        findings, _ = engine.run([str(p)], all_rules())
+        rules = [f.rule for f in findings]
+        assert engine.UNJUSTIFIED not in rules     # docstring: no phantom
+        assert "GL103" in rules                    # string didn't suppress
+
+    def test_remat_rule_ignores_same_named_class_elsewhere(self, tmp_path):
+        """A class sharing its NAME with a remat-wrapped class in another
+        module is not judged — wrap sites bind to the defining module via
+        import resolution, not bare-name union across the lint root."""
+        (tmp_path / "a.py").write_text(
+            "import flax.linen as nn\n"
+            "import jax\n"
+            "from jax.ad_checkpoint import checkpoint_name\n\n"
+            "POL = jax.checkpoint_policies.save_only_these_names('t_out')\n\n\n"
+            "class Block(nn.Module):\n"
+            "    def __call__(self, x):\n"
+            "        return checkpoint_name(x, 't_out')\n\n\n"
+            "wrapped = nn.remat(Block, policy=POL)\n")
+        (tmp_path / "b.py").write_text(
+            "class Block:\n"                 # unrelated, never wrapped
+            "    def render(self):\n"
+            "        return 'html'\n")
+        findings, _ = engine.run(
+            [str(tmp_path / "a.py"), str(tmp_path / "b.py")],
+            all_rules(), select={"GL105"})
+        assert findings == [], [f.message for f in findings]
+
+    def test_syntax_error_is_gl000(self, tmp_path):
+        p = tmp_path / "broken.py"
+        p.write_text("def f(:\n")
+        findings, _ = engine.run([str(p)], all_rules())
+        assert [f.rule for f in findings] == [engine.PARSE_ERROR]
+
+    def test_json_reporter_shape(self, tmp_path):
+        p = tmp_path / "clean.py"
+        p.write_text("x = 1\n")
+        findings, files = engine.run([str(p)], all_rules())
+        payload = json.loads(json_report(findings, files, [str(p)]))
+        assert payload["clean"] is True
+        assert payload["files_scanned"] == 1
+        assert payload["findings"] == []
+        assert payload["schema_version"] == 1
+
+    def test_out_json_with_text_stdout(self, tmp_path):
+        """One run, both reports: text on stdout, JSON at --out *.json —
+        the scripts/lint.sh evidence path."""
+        p = tmp_path / "clean.py"
+        p.write_text("x = 1\n")
+        out = tmp_path / "report.json"
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graphlint", str(p),
+             "--out", str(out)],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        assert "finding(s) in 1 file(s) scanned" in proc.stdout  # text
+        payload = json.loads(out.read_text())                    # json
+        assert payload["clean"] is True
+
+
+class TestTreeGate:
+    def test_shipped_tree_lints_clean(self):
+        """Acceptance: the shipped byol_tpu/ tree exits 0 through the SAME
+        entrypoint scripts/lint.sh runs — tier-1 DOTS_PASSED gates the
+        lint."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graphlint", "byol_tpu/"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, (
+            "graphlint found new issues in byol_tpu/:\n" + proc.stdout)
+
+    def test_list_rules_catalog(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graphlint", "--list-rules", "."],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0
+        for rule_id in ("GL101", "GL102", "GL103", "GL104", "GL105",
+                        "GL106", "GL001", "GL000"):
+            assert rule_id in proc.stdout
+
+    def test_missing_path_exits_2(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graphlint",
+             "no/such/path_xyz.py"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 2

@@ -52,9 +52,14 @@ def fresh(state):
 
 
 @pytest.fixture(scope="module")
-def training(mesh8_module):
+def training(mesh8_module, step_guard):
     rcfg = tiny_config()
-    return rcfg, setup_training(rcfg, mesh8_module, jax.random.PRNGKey(0))
+    net, state, train_step, eval_step, sched = setup_training(
+        rcfg, mesh8_module, jax.random.PRNGKey(0))
+    # Guarded steps: implicit host transfers / tracer leaks inside the step
+    # fail here, on CPU, in tier-1 — not on a TPU window (conftest.py).
+    return rcfg, (net, state, step_guard(train_step), step_guard(eval_step),
+                  sched)
 
 
 @pytest.fixture(scope="module")
@@ -130,7 +135,8 @@ class TestTrainStep:
 
 class TestShardingSemantics:
     @pytest.mark.slow
-    def test_global_batch_grads_match_single_device(self, mesh8_module):
+    def test_global_batch_grads_match_single_device(self, mesh8_module,
+                                                    step_guard):
         """The sharded step must produce the same result as an unsharded
         oracle on one device — DDP-allreduce + SyncBN equivalence
         (SURVEY.md §4 'distributed-without-a-cluster')."""
@@ -139,7 +145,7 @@ class TestShardingSemantics:
             rcfg, mesh8_module, jax.random.PRNGKey(0))
         batch_np = make_batch(rcfg)
         batch = shard_batch_to_mesh(batch_np, mesh8_module)
-        sharded_state, sharded_metrics = train_step(state, batch)
+        sharded_state, sharded_metrics = step_guard(train_step)(state, batch)
 
         # Single-device oracle: same net/params, jit with no sharding.
         # setup_training derives its init key via split_named (core/rng.py);
@@ -185,6 +191,51 @@ class TestStateBuffers:
         np.testing.assert_array_equal(
             np.asarray(st.opt_state["prev_params"]["w"]),
             np.asarray(st.params["w"]))
+
+
+class TestNormalizeInputs:
+    def test_imagenet_standardization_math(self):
+        from byol_tpu.training.steps import (IMAGENET_MEAN, IMAGENET_STD,
+                                             normalize_images)
+        x = jnp.full((1, 2, 2, 3), 0.5, jnp.float32)
+        y = np.asarray(normalize_images(x))
+        expect = (0.5 - np.array(IMAGENET_MEAN)) / np.array(IMAGENET_STD)
+        np.testing.assert_allclose(y[0, 0, 0], expect, rtol=1e-6)
+
+    def test_grayscale_fallback_uses_channel_mean(self):
+        from byol_tpu.training.steps import (IMAGENET_MEAN, IMAGENET_STD,
+                                             normalize_images)
+        g = jnp.full((1, 2, 2, 1), 0.5, jnp.float32)
+        y = np.asarray(normalize_images(g))
+        assert y.shape == (1, 2, 2, 1)
+        expect = (0.5 - np.mean(IMAGENET_MEAN)) / np.mean(IMAGENET_STD)
+        np.testing.assert_allclose(y[0, 0, 0, 0], expect, rtol=1e-6)
+
+    def test_extractor_normalize_matches_manual(self, training):
+        """The linear-eval extractor's normalize=True must equal feeding
+        pre-normalized pixels to normalize=False — the trained input
+        contract is ONE function (steps.normalize_images), not two
+        implementations drifting apart."""
+        from byol_tpu.training.linear_eval import encoder_apply_fn
+        from byol_tpu.training.steps import normalize_images
+        rcfg, (net, state, _, _, _) = training
+        state = fresh(state)
+        x = jnp.asarray(make_batch(rcfg)["view1"][:8])
+        f_norm = encoder_apply_fn(net, state, normalize=True)(x)
+        f_manual = encoder_apply_fn(net, state,
+                                    normalize=False)(normalize_images(x))
+        np.testing.assert_allclose(np.asarray(f_norm),
+                                   np.asarray(f_manual), atol=1e-5)
+
+    def test_step_config_carries_the_knob(self):
+        import dataclasses as dc
+        from byol_tpu.training.build import step_config
+        rcfg = tiny_config()
+        assert step_config(rcfg).normalize_inputs is False
+        c = rcfg.cfg.replace(
+            parity=dc.replace(rcfg.cfg.parity, normalize_inputs=True))
+        rcfg_on = dc.replace(rcfg, cfg=c)
+        assert step_config(rcfg_on).normalize_inputs is True
 
 
 class TestParityModes:

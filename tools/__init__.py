@@ -1,0 +1,1 @@
+# first-party developer tooling (tools.graphlint); not shipped with byol_tpu

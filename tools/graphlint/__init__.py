@@ -1,0 +1,29 @@
+"""graphlint — first-party JAX-aware static analysis for byol_tpu.
+
+Rule catalog (see ``python -m tools.graphlint --list-rules``):
+
+====== ==========================================================
+GL101  host-device sync points inside jit/scan-reachable code
+GL102  recompile hazards (jit-in-loop, unhashable statics,
+       jitted closures over arrays)
+GL103  PRNG key consumed twice without split/fold_in
+GL104  use-after-donate of donate_argnums buffers
+GL105  remat-tag coverage/drift vs the named checkpoint policies
+GL106  CLI/config drift (unreachable fields, unconsumed flags)
+GL001  suppression comment without a justification
+GL000  file does not parse
+====== ==========================================================
+
+Suppress a finding with an inline justification::
+
+    risky_line()  # graphlint: disable=GL101 -- readback is epoch-boundary
+
+Runtime complements live in tests/conftest.py (``jax.transfer_guard`` +
+tracer-leak fixtures) and core/remat.py (``assert_tags_in_trace``) — the
+static rules reject what the AST can prove, the guards catch the rest on
+CPU in tier-1.
+"""
+from tools.graphlint.engine import Finding, run          # noqa: F401
+from tools.graphlint.rules import all_rules              # noqa: F401
+
+__version__ = "0.1.0"

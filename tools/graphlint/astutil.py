@@ -1,0 +1,410 @@
+"""Shared AST analysis for graphlint rules.
+
+Three building blocks every JAX-aware rule needs:
+
+- **qualified-name resolution** (:class:`ImportMap`, :func:`qualname`):
+  ``jnp.asarray`` -> ``jax.numpy.asarray`` regardless of how the module
+  spelled its imports, so rules match on canonical dotted paths;
+- **traced-scope detection** (:func:`traced_functions`): which function
+  bodies end up inside ``jax.jit`` / ``lax.scan`` / ``vmap`` / flax
+  ``__call__`` traces.  This is a *module-local, syntactic* approximation —
+  a function jitted from another module is invisible — which is exactly why
+  the tier-1 runtime guards (``jax.transfer_guard`` + tracer-leak checks)
+  exist alongside the static rules;
+- **expression classification** (:class:`ExprClassifier`): STATIC (shape /
+  dtype / python-scalar arithmetic, safe to ``float()``), ARRAY (provably a
+  jax value), or UNKNOWN.  Rules flag ARRAY aggressively and UNKNOWN only
+  where the operation is near-always wrong (``np.*`` in traced code), to
+  keep the false-positive rate at zero on the shipped tree.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Import alias resolution
+
+
+class ImportMap:
+    """Maps local names to canonical dotted prefixes.
+
+    ``import jax.numpy as jnp``      -> jnp: jax.numpy
+    ``from jax import lax``          -> lax: jax.lax
+    ``from jax.random import split`` -> split: jax.random.split
+    """
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                # relative imports keep the module tail only (no package
+                # anchor in a single-file AST); consumers must suffix-match
+                # dotted paths rather than compare for equality
+                base = node.module
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{base}.{a.name}"
+
+    def resolve(self, name: str) -> str:
+        head, _, tail = name.partition(".")
+        base = self.aliases.get(head, head)
+        return f"{base}.{tail}" if tail else base
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` Attribute/Name chain -> "a.b.c" (None for anything else)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def qualname(node: ast.AST, imports: ImportMap) -> Optional[str]:
+    """Canonical dotted path of a Name/Attribute chain, alias-resolved."""
+    d = dotted(node)
+    return imports.resolve(d) if d else None
+
+
+def last_segment(node: ast.AST) -> Optional[str]:
+    """Terminal attribute/name of a call target: ``remat_lib.wrap_block`` ->
+    "wrap_block" — for matching project-local helpers imported any way."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def const_str(node: ast.AST, module_consts: Dict[str, str]) -> Optional[str]:
+    """Resolve a string literal or a Name bound to a module-level string."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return module_consts.get(node.id)
+    return None
+
+
+def module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments."""
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            out[stmt.targets[0].id] = stmt.value.value
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Traced-scope detection
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# Calling one of these with a function argument stages that function out for
+# tracing; decorating with one does the same to the decorated function.
+TRACING_CALLS: Set[str] = {
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.jacfwd", "jax.jacrev", "jax.hessian", "jax.linearize", "jax.vjp",
+    "jax.jvp", "jax.checkpoint", "jax.remat", "jax.eval_shape",
+    "jax.make_jaxpr", "jax.named_call", "jax.custom_jvp", "jax.custom_vjp",
+    "jax.lax.scan", "jax.lax.map", "jax.lax.cond", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.associative_scan",
+    "jax.lax.custom_root", "jax.ad_checkpoint.checkpoint",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pallas.pallas_call",
+    "flax.linen.jit", "flax.linen.remat", "flax.linen.scan",
+    "flax.linen.vmap",
+}
+
+TRACED_DECORATORS: Set[str] = TRACING_CALLS | {"flax.linen.compact"}
+
+FLAX_MODULE_BASES = {"flax.linen.Module", "flax.linen.nn.Module"}
+
+
+def _decorator_is_traced(dec: ast.AST, imports: ImportMap) -> bool:
+    q = qualname(dec, imports)
+    if q in TRACED_DECORATORS:
+        return True
+    if isinstance(dec, ast.Call):
+        fq = qualname(dec.func, imports)
+        if fq in TRACED_DECORATORS:          # @jax.jit(static_argnums=...)
+            return True
+        if fq == "functools.partial" and dec.args:
+            return qualname(dec.args[0], imports) in TRACED_DECORATORS
+    return False
+
+
+def _function_args_of_call(call: ast.Call, imports: ImportMap
+                           ) -> Iterable[ast.AST]:
+    """Argument nodes of a tracing call that are staged for tracing —
+    positional args plus the usual callable kwargs, unwrapping
+    ``functools.partial(fn, ...)``."""
+    cands = list(call.args)
+    for kw in call.keywords:
+        if kw.arg in ("f", "fun", "body_fun", "cond_fun", "body", "kernel"):
+            cands.append(kw.value)
+    for c in cands:
+        if (isinstance(c, ast.Call)
+                and qualname(c.func, imports) == "functools.partial"
+                and c.args):
+            c = c.args[0]
+        yield c
+
+
+def traced_functions(tree: ast.Module, imports: ImportMap
+                     ) -> Set[ast.AST]:
+    """All function-like nodes whose bodies run under a JAX trace.
+
+    Marks: (1) traced-decorated defs; (2) defs/lambdas passed (by name or
+    directly) to tracing calls; (3) flax ``nn.Module`` methods — the
+    ``@nn.compact``/``__call__``/``setup`` surface; then closes over (4)
+    nesting (a def inside a traced def is traced) and (5) module-local
+    calls (a traced body calling a locally-defined function by bare name,
+    or ``self.method()``, marks the callee).
+    """
+    funcs = [n for n in ast.walk(tree) if isinstance(n, FuncNode)]
+    by_name: Dict[str, List[ast.AST]] = {}
+    for f in funcs:
+        if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(f.name, []).append(f)
+
+    traced: Set[ast.AST] = set()
+
+    # (1) decorators
+    for f in funcs:
+        if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_decorator_is_traced(d, imports) for d in f.decorator_list):
+                traced.add(f)
+
+    # (2) passed to tracing calls
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and qualname(node.func, imports) in TRACING_CALLS):
+            continue
+        for arg in _function_args_of_call(node, imports):
+            if isinstance(arg, ast.Lambda):
+                traced.add(arg)
+            elif isinstance(arg, ast.Name):
+                traced.update(by_name.get(arg.id, ()))
+
+    # (3) flax module methods
+    flax_methods = {"__call__", "setup"}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        is_flax = any(qualname(b, imports) in FLAX_MODULE_BASES
+                      for b in cls.bases)
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            compact = any(qualname(d, imports) == "flax.linen.compact"
+                          for d in item.decorator_list)
+            if compact or (is_flax and item.name in flax_methods):
+                traced.add(item)
+
+    # (4)+(5) closure: nesting and local calls
+    parents = parent_function_map(tree)
+    changed = True
+    while changed:
+        changed = False
+        for f in funcs:
+            if f in traced:
+                continue
+            p = parents.get(f)
+            if p is not None and p in traced:
+                traced.add(f)
+                changed = True
+        for f in list(traced):
+            for node in ast.walk(f):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee: List[ast.AST] = []
+                if isinstance(node.func, ast.Name):
+                    callee = by_name.get(node.func.id, [])
+                elif (isinstance(node.func, ast.Attribute)
+                      and isinstance(node.func.value, ast.Name)
+                      and node.func.value.id == "self"):
+                    callee = by_name.get(node.func.attr, [])
+                for c in callee:
+                    if c not in traced:
+                        traced.add(c)
+                        changed = True
+    return traced
+
+
+def parent_function_map(tree: ast.Module) -> Dict[ast.AST, ast.AST]:
+    """function node -> innermost enclosing function node (if any)."""
+    out: Dict[ast.AST, ast.AST] = {}
+
+    def visit(node: ast.AST, enclosing: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FuncNode):
+                if enclosing is not None:
+                    out[child] = enclosing
+                visit(child, child)
+            else:
+                visit(child, enclosing)
+
+    visit(tree, None)
+    return out
+
+
+def direct_body_walk(func: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function-like
+    nodes (they are analyzed as scopes of their own)."""
+    body = func.body if not isinstance(func, ast.Lambda) else [func.body]
+    stack: List[ast.AST] = list(body) if isinstance(body, list) else [body]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FuncNode):
+                continue
+            stack.append(child)
+
+
+# ---------------------------------------------------------------------------
+# Expression classification
+
+STATIC, ARRAY, UNKNOWN = "static", "array", "unknown"
+
+_STATIC_ANNOTATIONS = {"int", "float", "bool", "str", "bytes", "Tuple",
+                       "tuple", "Sequence", "Optional[int]", "Optional[float]"}
+_ARRAY_ANNOTATION_HINTS = ("Array", "ndarray", "DeviceArray")
+_ARRAY_CALL_ROOTS = ("jax.numpy.", "jax.random.", "jax.lax.", "jax.nn.",
+                     "jax.image.", "jax.scipy.")
+_STATIC_BUILTINS = {"len", "range", "min", "max", "abs", "int", "float",
+                    "bool", "round", "sorted", "tuple", "str"}
+
+
+class ExprClassifier:
+    """Classify expressions within one function scope.
+
+    ``env`` is seeded from parameter annotations and grown by a linear pass
+    over simple assignments (see :meth:`bind_assign`)."""
+
+    def __init__(self, imports: ImportMap,
+                 env: Optional[Dict[str, str]] = None) -> None:
+        self.imports = imports
+        self.env: Dict[str, str] = dict(env or {})
+
+    @classmethod
+    def for_function(cls, func: ast.AST, imports: ImportMap
+                     ) -> "ExprClassifier":
+        self = cls(imports)
+        if isinstance(func, FuncNode):
+            args = func.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                ann = a.annotation
+                if ann is None:
+                    continue
+                text = ast.dump(ann)
+                src = dotted(ann) or (
+                    ann.value if isinstance(ann, ast.Constant) else "")
+                name = src if isinstance(src, str) else ""
+                if name.split(".")[-1] in _STATIC_ANNOTATIONS:
+                    self.env[a.arg] = STATIC
+                elif any(h in text for h in _ARRAY_ANNOTATION_HINTS):
+                    self.env[a.arg] = ARRAY
+        return self
+
+    def bind_assign(self, stmt: ast.Assign) -> None:
+        kind = self.classify(stmt.value)
+        targets: List[ast.AST] = []
+        for t in stmt.targets:
+            targets.extend(t.elts if isinstance(t, (ast.Tuple, ast.List))
+                           else [t])
+        # tuple-unpack of .shape: every target is a static python int
+        if (len(targets) > 1 and isinstance(stmt.value, ast.Attribute)
+                and stmt.value.attr == "shape"):
+            kind = STATIC
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.env[t.id] = kind
+
+    def classify(self, node: ast.AST) -> str:
+        if isinstance(node, ast.Constant):
+            return STATIC
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("shape", "ndim", "dtype", "size", "itemsize"):
+                return STATIC
+            if dotted(node) and dotted(node).startswith("self."):
+                return STATIC        # module hyperparameters (flax fields)
+            return UNKNOWN
+        if isinstance(node, ast.Subscript):
+            return self.classify(node.value)
+        if isinstance(node, (ast.BinOp,)):
+            return self._combine([node.left, node.right])
+        if isinstance(node, ast.UnaryOp):
+            return self.classify(node.operand)
+        if isinstance(node, ast.Compare):
+            return self._combine([node.left] + list(node.comparators))
+        if isinstance(node, ast.BoolOp):
+            return self._combine(node.values)
+        if isinstance(node, ast.IfExp):
+            return self._combine([node.body, node.orelse])
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return self._combine(node.elts)
+        if isinstance(node, ast.Call):
+            q = qualname(node.func, self.imports)
+            if q and any(q.startswith(r) for r in _ARRAY_CALL_ROOTS):
+                return ARRAY
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _STATIC_BUILTINS):
+                inner = self._combine(node.args) if node.args else STATIC
+                return STATIC if inner == STATIC else inner
+            return UNKNOWN
+        if isinstance(node, ast.JoinedStr):
+            return STATIC
+        return UNKNOWN
+
+    def _combine(self, nodes: List[ast.AST]) -> str:
+        kinds = [self.classify(n) for n in nodes]
+        if ARRAY in kinds:
+            return ARRAY
+        if kinds and all(k == STATIC for k in kinds):
+            return STATIC
+        return UNKNOWN
+
+
+def int_tuple_literal(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Evaluate an int or tuple-of-ints literal (``donate_argnums=(0,)``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def str_tuple_literal(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Evaluate a str or tuple-of-strs literal (``static_argnames=...``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None

@@ -1,0 +1,73 @@
+"""graphlint command line.
+
+``python -m tools.graphlint byol_tpu/`` — exit 0 when clean, 1 when any
+finding survives suppression, 2 on usage errors.  The tool is pure AST: it
+never imports the code under analysis, so it runs in seconds on CPU with
+no jax/TPU initialization — the whole point is rejecting bad programs
+*before* they burn a TPU window.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from tools.graphlint import engine
+from tools.graphlint.reporters import json_report, text_report
+from tools.graphlint.rules import all_rules
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graphlint",
+        description="JAX-aware static analysis: host syncs, recompile "
+                    "hazards, PRNG reuse, use-after-donate, remat-tag "
+                    "drift, CLI/config drift")
+    p.add_argument("paths", nargs="+", help="files or directories to lint")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--out", default=None,
+                   help="also write the report to this file; a path ending "
+                        "in .json gets the JSON report regardless of "
+                        "--format, so one run yields human text on stdout "
+                        "AND evidence/graphlint.json")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.name}: {r.doc}")
+        print(f"{engine.PARSE_ERROR}  parse-error: file does not parse")
+        print(f"{engine.UNJUSTIFIED}  unjustified-suppression: "
+              "disable comment without '-- reason'")
+        return 0
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+    try:
+        findings, files = engine.run(args.paths, rules, select=select)
+    except FileNotFoundError as e:
+        print(f"graphlint: no such path: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        report = json_report(findings, files, args.paths)
+    else:
+        report = text_report(findings, files)
+    print(report, end="" if report.endswith("\n") else "\n")
+    if args.out:
+        out_report = (json_report(findings, files, args.paths)
+                      if args.out.endswith(".json") else report)
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(out_report if out_report.endswith("\n")
+                     else out_report + "\n")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

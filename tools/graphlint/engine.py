@@ -1,0 +1,209 @@
+"""graphlint rule engine: file discovery, suppression handling, two-phase
+rule execution.
+
+Rules are two-phase so cross-file invariants (remat-tag coverage, CLI/config
+drift) see the whole lint root before judging any one file:
+
+1. ``collect(file, ctx)`` over every file — rules stash whatever global
+   state they need on ``ctx``;
+2. ``check(file, ctx)`` over every file — rules emit :class:`Finding`\\ s.
+
+Suppressions: ``# graphlint: disable=GL103 -- why this is safe`` on the
+offending line (or on a comment-only line directly above it) suppresses the
+named rule(s); ``disable=all`` suppresses everything on that line.  A
+suppression without the ``-- justification`` tail still suppresses, but
+emits a GL001 finding of its own — the acceptance bar is *zero unexplained
+suppressions*, enforced by the tool rather than by review.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.graphlint.astutil import ImportMap
+
+SUPPRESS_RE = re.compile(
+    r"#\s*graphlint:\s*disable=([A-Za-z0-9_,\s]+?)"
+    r"(?:\s*--\s*(\S.*?))?\s*$")
+
+PARSE_ERROR = "GL000"
+UNJUSTIFIED = "GL001"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str               # repo-relative (or as-given) path
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> Tuple[str, int, int, str]:
+        return (self.path, self.line, self.col, self.rule)
+
+
+@dataclasses.dataclass
+class Suppression:
+    rules: Set[str]          # rule ids, or {"all"}
+    justified: bool
+    line: int
+
+    def covers(self, rule_id: str) -> bool:
+        return "all" in self.rules or rule_id in self.rules
+
+
+class LintedFile:
+    """One parsed source file plus its comment-level suppressions."""
+
+    def __init__(self, path: str, rel: str, source: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        self.imports = (ImportMap(self.tree) if self.tree is not None
+                        else None)
+        # lineno -> Suppression; a suppression on a comment-only line also
+        # covers the next line (suppress-above style).  Comments are found
+        # via tokenize, NOT a regex over raw lines — suppression-like text
+        # inside a string/docstring (a usage example) must neither suppress
+        # nor emit GL001.
+        self.suppressions: Dict[int, Suppression] = {}
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            tokens = []     # unparseable file: GL000 covers it
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            i = tok.start[0]
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            sup = Suppression(rules=rules, justified=bool(m.group(2)),
+                              line=i)
+            self.suppressions[i] = sup
+            if not tok.line[:tok.start[1]].strip():   # comment-only line
+                self.suppressions.setdefault(i + 1, sup)
+
+    def suppressed(self, finding: Finding) -> bool:
+        sup = self.suppressions.get(finding.line)
+        return sup is not None and sup.covers(finding.rule)
+
+
+class Context:
+    """Shared state across the whole lint run (cross-file rule storage)."""
+
+    def __init__(self, files: Sequence[LintedFile]) -> None:
+        self.files = files
+        self.store: Dict[str, object] = {}
+
+
+class Line:
+    """Minimal node-like anchor for findings not tied to one AST node
+    (cross-file rules judging a class/field by its declaration line)."""
+
+    def __init__(self, lineno: int) -> None:
+        self.lineno = lineno
+        self.col_offset = 0
+
+
+class Rule:
+    id: str = "GL???"
+    name: str = "unnamed"
+    doc: str = ""
+
+    def collect(self, f: LintedFile, ctx: Context) -> None:
+        """Phase 1: gather cross-file state; no findings yet."""
+
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        """Phase 2: emit findings for this file."""
+        return []
+
+    def finding(self, f: LintedFile, node, message: str) -> Finding:
+        return Finding(rule=self.id, path=f.rel,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message)
+
+
+def discover(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted list of .py files."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".")
+                                 and d != "__pycache__")
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        out.append(os.path.join(root, n))
+        else:
+            raise FileNotFoundError(p)
+    return sorted(dict.fromkeys(out))
+
+
+def load_files(paths: Sequence[str]) -> List[LintedFile]:
+    files = []
+    cwd = os.getcwd()
+    for p in discover(paths):
+        with open(p, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        rel = os.path.relpath(os.path.abspath(p), cwd)
+        files.append(LintedFile(p, rel, source))
+    return files
+
+
+def run(paths: Sequence[str], rules: Sequence[Rule],
+        select: Optional[Set[str]] = None
+        ) -> Tuple[List[Finding], List[LintedFile]]:
+    """Lint ``paths`` with ``rules``; returns (findings, files)."""
+    if select:
+        rules = [r for r in rules if r.id in select]
+    files = load_files(paths)
+    findings: List[Finding] = []
+
+    for f in files:
+        if f.parse_error is not None:
+            findings.append(Finding(PARSE_ERROR, f.rel, 0, 1,
+                                    f"syntax error: {f.parse_error}"))
+    parsed = [f for f in files if f.tree is not None]
+
+    ctx = Context(parsed)
+    for rule in rules:
+        for f in parsed:
+            rule.collect(f, ctx)
+    for rule in rules:
+        for f in parsed:
+            for fd in rule.check(f, ctx):
+                if not f.suppressed(fd):
+                    findings.append(fd)
+
+    # unjustified suppressions are findings themselves (GL001)
+    for f in parsed:
+        seen: Set[int] = set()
+        for sup in f.suppressions.values():
+            if sup.justified or sup.line in seen:
+                continue
+            seen.add(sup.line)
+            findings.append(Finding(
+                UNJUSTIFIED, f.rel, sup.line, 1,
+                "suppression without justification: append "
+                "'-- <one-line reason>'"))
+
+    findings = sorted(set(findings), key=Finding.key)
+    return findings, files

@@ -1,0 +1,41 @@
+"""Finding reporters: human text and machine JSON.
+
+The JSON shape is stable on purpose — scripts/lint.sh writes it to
+``evidence/graphlint.json`` so rule-count trends are diffable across PRs.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from tools.graphlint.engine import Finding, LintedFile
+
+SCHEMA_VERSION = 1
+
+
+def text_report(findings: Sequence[Finding],
+                files: Sequence[LintedFile]) -> str:
+    lines = [f"{fd.path}:{fd.line}:{fd.col}: {fd.rule} {fd.message}"
+             for fd in findings]
+    lines.append(f"graphlint: {len(findings)} finding(s) in "
+                 f"{len(files)} file(s) scanned")
+    return "\n".join(lines)
+
+
+def json_report(findings: Sequence[Finding],
+                files: Sequence[LintedFile],
+                roots: Sequence[str]) -> str:
+    counts: Dict[str, int] = {}
+    for fd in findings:
+        counts[fd.rule] = counts.get(fd.rule, 0) + 1
+    payload = {
+        "schema_version": SCHEMA_VERSION,
+        "roots": list(roots),
+        "files_scanned": len(files),
+        "findings": [
+            {"rule": fd.rule, "path": fd.path, "line": fd.line,
+             "col": fd.col, "message": fd.message} for fd in findings],
+        "counts_by_rule": dict(sorted(counts.items())),
+        "clean": not findings,
+    }
+    return json.dumps(payload, indent=2, sort_keys=True) + "\n"

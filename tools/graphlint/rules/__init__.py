@@ -1,0 +1,17 @@
+"""graphlint rule registry."""
+from __future__ import annotations
+
+from typing import List
+
+from tools.graphlint.engine import Rule
+from tools.graphlint.rules.cli_drift import CliDriftRule
+from tools.graphlint.rules.donate import DonateRule
+from tools.graphlint.rules.host_sync import HostSyncRule
+from tools.graphlint.rules.prng import PRNGReuseRule
+from tools.graphlint.rules.recompile import RecompileRule
+from tools.graphlint.rules.remat_tags import RematTagRule
+
+
+def all_rules() -> List[Rule]:
+    return [HostSyncRule(), RecompileRule(), PRNGReuseRule(),
+            DonateRule(), RematTagRule(), CliDriftRule()]

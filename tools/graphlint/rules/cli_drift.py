@@ -1,0 +1,190 @@
+"""GL106 — CLI/config drift.
+
+The config is the contract between the operator and the run: a dataclass
+field that no CLI flag can set is dead weight that silently pins behavior
+(the paper recipe's knob exists but cannot be turned), and a parsed flag
+nobody reads is worse — the operator believes they changed something.
+Both directions rotted in the reference (SURVEY.md App B) and both are
+checkable statically:
+
+- **field -> flag**: every field of every frozen config *section* class
+  must appear as a constructor keyword in a builder function (a function
+  taking an ``argparse.Namespace``-ish ``args`` and instantiating
+  sections);
+- **flag -> consumption**: every ``add_argument`` destination must be read
+  as ``args.<dest>`` somewhere in the linted tree.
+
+Section classes are found structurally: dataclass-decorated classes
+(including local wrappers like config.py's ``_frozen``) instantiated from
+at least one builder.  Classes never touched by a builder (StepConfig,
+MeshSpec, ...) are out of scope by construction.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graphlint.astutil import qualname
+from tools.graphlint.engine import (Context, Finding, Line, LintedFile,
+                                    Rule)
+
+
+class _Store:
+    def __init__(self) -> None:
+        # class name -> (rel, {field: line})
+        self.sections: Dict[str, Tuple[str, Dict[str, int]]] = {}
+        # class name -> kwargs passed across all builder instantiations
+        self.built_with: Dict[str, Set[str]] = {}
+        self.args_reads: Set[str] = set()
+        # dest -> (rel, line, flag)
+        self.flags: Dict[str, Tuple[str, int, str]] = {}
+
+
+def _store(ctx: Context) -> _Store:
+    return ctx.store.setdefault("cli_drift", _Store())
+
+
+def _dataclass_wrappers(tree: ast.Module, imports) -> Set[str]:
+    """Local decorator functions that apply dataclasses.dataclass (the
+    config.py ``_frozen`` pattern)."""
+    out: Set[str] = set()
+    for fn in tree.body:
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and qualname(node.func, imports)
+                    in ("dataclasses.dataclass", "dataclass")):
+                out.add(fn.name)
+    return out
+
+
+def _is_dataclass(cls: ast.ClassDef, wrappers: Set[str], imports) -> bool:
+    for d in cls.decorator_list:
+        q = qualname(d, imports)
+        if q in ("dataclasses.dataclass", "dataclass",
+                 "flax.struct.dataclass"):
+            return True
+        if isinstance(d, ast.Name) and d.id in wrappers:
+            return True
+        if isinstance(d, ast.Call):
+            fq = qualname(d.func, imports)
+            if fq in ("dataclasses.dataclass", "dataclass"):
+                return True
+    return False
+
+
+def _namespace_params(fn: ast.FunctionDef) -> Set[str]:
+    """Parameters that hold parsed CLI args: named ``args`` or annotated
+    ``*Namespace``."""
+    out: Set[str] = set()
+    for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs):
+        ann = ""
+        if a.annotation is not None:
+            ann = ast.unparse(a.annotation) if hasattr(ast, "unparse") \
+                else ""
+        if a.arg == "args" or "Namespace" in ann:
+            out.add(a.arg)
+    return out
+
+
+class CliDriftRule(Rule):
+    id = "GL106"
+    name = "cli-config-drift"
+    doc = ("every config field reachable from a CLI flag and every flag "
+           "consumed")
+
+    # ------------------------------------------------------------- phase 1
+    def collect(self, f: LintedFile, ctx: Context) -> None:
+        st = _store(ctx)
+        wrappers = _dataclass_wrappers(f.tree, f.imports)
+
+        for cls in f.tree.body:
+            if (isinstance(cls, ast.ClassDef)
+                    and _is_dataclass(cls, wrappers, f.imports)):
+                fields = {
+                    s.target.id: s.lineno for s in cls.body
+                    if isinstance(s, ast.AnnAssign)
+                    and isinstance(s.target, ast.Name)}
+                if fields:
+                    st.sections.setdefault(cls.name, (f.rel, fields))
+
+        # parser flags
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument" and node.args):
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                    and first.value.startswith("--")):
+                continue
+            dest = None
+            for kw in node.keywords:
+                if kw.arg == "dest" and isinstance(kw.value, ast.Constant):
+                    dest = kw.value.value
+            if dest is None:
+                dest = first.value.lstrip("-").replace("-", "_")
+            st.flags.setdefault(dest, (f.rel, node.lineno, first.value))
+
+        # args.X reads + builder constructor kwargs
+        for fn in ast.walk(f.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            ns = _namespace_params(fn)
+            # names locally bound from parse_args() also carry CLI args
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Attribute)
+                        and node.value.func.attr == "parse_args"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            ns.add(t.id)
+            if not ns:
+                continue
+            reads_args = False
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in ns):
+                    st.args_reads.add(node.attr)
+                    reads_args = True
+            if not reads_args:
+                continue
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)):
+                    kws = st.built_with.setdefault(node.func.id, set())
+                    for kw in node.keywords:
+                        if kw.arg is not None:
+                            kws.add(kw.arg)
+
+    # ------------------------------------------------------------- phase 2
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        st = _store(ctx)
+        findings: List[Finding] = []
+
+        for cls_name, (rel, fields) in sorted(st.sections.items()):
+            if rel != f.rel or cls_name not in st.built_with:
+                continue
+            passed = st.built_with[cls_name]
+            for field, line in sorted(fields.items()):
+                if field not in passed:
+                    findings.append(self.finding(
+                        f, Line(line), f"config field "
+                        f"{cls_name}.{field} is not settable from any CLI "
+                        "flag (no builder passes it) — dead knob or "
+                        "missing add_argument"))
+
+        for dest, (rel, line, flag) in sorted(st.flags.items()):
+            if rel != f.rel:
+                continue
+            if dest not in st.args_reads:
+                findings.append(self.finding(
+                    f, Line(line), f"flag {flag} parses into "
+                    f"args.{dest} but nothing ever reads it — the "
+                    "operator's setting is silently dropped"))
+        return findings
+

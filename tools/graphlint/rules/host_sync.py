@@ -1,0 +1,90 @@
+"""GL101 — host-device sync points inside trace-reachable code.
+
+Inside a jitted/scanned/vmapped function, materializing a traced value on
+the host either fails at trace time (``float()`` of a tracer) or — worse —
+silently runs at trace time on a constant and bakes a stale value into the
+executable.  On a TPU the benign-looking variants (``np.asarray`` on a
+committed array, ``.item()``, ``jax.device_get``) insert a device-to-host
+round trip per step, which stalls the pipelined dispatch the whole trainer
+is built around (observability/meters.py docstrings).
+
+Flagged inside traced scopes:
+- any ``numpy.*`` call whose arguments are not all provably static
+  (shape/dtype arithmetic is fine; tensors are not);
+- ``jax.device_get`` (a transfer by definition);
+- ``.item()`` / ``.tolist()`` / ``.block_until_ready()`` /
+  ``.copy_to_host_async()`` on non-static receivers;
+- ``float()`` / ``int()`` / ``bool()`` on values *provably* arrays (derived
+  from jnp/jax calls or array-annotated parameters).  Unknown scalars are
+  deliberately not flagged — hyperparameter plumbing would drown the signal.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graphlint.astutil import (ARRAY, STATIC, ExprClassifier, FuncNode,
+                                     direct_body_walk, qualname,
+                                     traced_functions)
+from tools.graphlint.engine import Context, Finding, LintedFile, Rule
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready",
+                 "copy_to_host_async", "__array__"}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+class HostSyncRule(Rule):
+    id = "GL101"
+    name = "host-sync-in-traced-code"
+    doc = ("host transfer / numpy materialization inside jit/scan-reachable "
+           "code")
+
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        traced = traced_functions(f.tree, f.imports)
+        for func in traced:
+            cls = ExprClassifier.for_function(func, f.imports)
+            for node in _linear(func):
+                if isinstance(node, ast.Assign):
+                    cls.bind_assign(node)
+                if not isinstance(node, ast.Call):
+                    continue
+                q = qualname(node.func, f.imports)
+                if q == "jax.device_get":
+                    findings.append(self.finding(
+                        f, node, "jax.device_get inside traced code forces "
+                        "a device->host transfer per step"))
+                    continue
+                if q and (q.startswith("numpy.") or q == "numpy"):
+                    args = list(node.args) + [k.value for k in node.keywords]
+                    if not args or any(cls.classify(a) != STATIC
+                                       for a in args):
+                        findings.append(self.finding(
+                            f, node, f"numpy call '{q}' on a traced value "
+                            "materializes it on the host (sync point); use "
+                            "jax.numpy or hoist out of the traced scope"))
+                    continue
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SYNC_METHODS
+                        and cls.classify(node.func.value) != STATIC):
+                    findings.append(self.finding(
+                        f, node, f".{node.func.attr}() inside traced code "
+                        "blocks on a device->host readback"))
+                    continue
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id in _CAST_BUILTINS
+                        and len(node.args) == 1
+                        and cls.classify(node.args[0]) == ARRAY):
+                    findings.append(self.finding(
+                        f, node, f"{node.func.id}() on a traced array value "
+                        "forces host materialization (TracerConversion at "
+                        "best, a silent per-step sync at worst)"))
+        return findings
+
+
+def _linear(func):
+    """Body walk in source order (classifier env needs assignments seen
+    before uses), skipping nested function scopes."""
+    return sorted(direct_body_walk(func),
+                  key=lambda n: (getattr(n, "lineno", 0),
+                                 getattr(n, "col_offset", 0)))

@@ -1,0 +1,298 @@
+"""GL103 — PRNG key discipline.
+
+A JAX PRNG key consumed twice yields *identical* randomness — the classic
+correlated-augmentation bug (data/device_augment.py's gate/sigma comment is
+a fossil of exactly this).  The rule tracks key-valued names through one
+function scope and flags the second consumption of the same key (or the
+same constant index of a split result) without an interposing rebind.
+
+Analysis, deliberately simple and linear:
+- tracked names: parameters/targets with key-ish names (``key``, ``rng``,
+  ``keys``, ``*_key`` ...) plus any assignment target of a
+  ``jax.random.{PRNGKey,key,split,fold_in,clone}`` call (tuple-unpack
+  included);
+- a *consumption* is any load of a tracked name (call argument, container
+  element, ...); ``split_result[CONST]`` consumes the (name, index) slot
+  instead of the whole name;
+- ``fold_in(key, data)`` with non-constant data is *derivation*, not
+  consumption (the standard per-step/per-index pattern);
+- ``if``/``else`` branches are walked independently and merged with max()
+  — a key used once in each branch is used once;
+- loop bodies are walked twice, so consuming an outer key anew each
+  iteration is caught, while rebind-per-iteration patterns stay clean.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+from tools.graphlint.astutil import FuncNode, last_segment, qualname
+from tools.graphlint.engine import Context, Finding, LintedFile, Rule
+
+
+def _terminates(stmts) -> bool:
+    """True when a block cannot fall through (ends in return/raise/...)."""
+    return any(isinstance(s, (ast.Return, ast.Raise, ast.Break,
+                              ast.Continue)) for s in stmts)
+
+KeyId = Union[str, Tuple[str, object]]
+
+_PRODUCERS = {"jax.random.PRNGKey", "jax.random.key", "jax.random.split",
+              "jax.random.fold_in", "jax.random.clone",
+              "jax.random.wrap_key_data"}
+_KEYISH_EXACT = {"key", "rng", "keys", "rngs", "subkey", "subkeys",
+                 "prng_key", "prng"}
+_KEYISH_SUFFIX = ("_key", "_rng", "_keys", "_rngs")
+
+
+def _keyish(name: str) -> bool:
+    return name in _KEYISH_EXACT or name.endswith(_KEYISH_SUFFIX)
+
+
+class _ScopeState:
+    def __init__(self) -> None:
+        self.tracked: Set[str] = set()
+        self.counts: Dict[KeyId, int] = {}
+
+    def copy(self) -> "_ScopeState":
+        s = _ScopeState()
+        s.tracked = set(self.tracked)
+        s.counts = dict(self.counts)
+        return s
+
+    def merge_max(self, other: "_ScopeState") -> None:
+        self.tracked |= other.tracked
+        for k, v in other.counts.items():
+            self.counts[k] = max(self.counts.get(k, 0), v)
+
+    def rebind(self, name: str) -> None:
+        self.counts.pop(name, None)
+        for k in [k for k in self.counts
+                  if isinstance(k, tuple) and k[0] == name]:
+            self.counts.pop(k)
+
+
+_SCALAR_ANNOTATIONS = {"str", "int", "float", "bool", "bytes"}
+
+
+class PRNGReuseRule(Rule):
+    id = "GL103"
+    name = "prng-key-reuse"
+    doc = ("a PRNG key consumed twice without an interposing "
+           "split/fold_in rebind")
+
+    def collect(self, f: LintedFile, ctx: Context) -> None:
+        """Derivation helpers: module functions wrapping ``fold_in`` with a
+        data argument (core/rng.py ``for_step``) — calling one with
+        varying data derives, it does not reuse."""
+        helpers = ctx.store.setdefault("prng_derive_helpers", set())
+        for fn in f.tree.body:
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and qualname(node.func, f.imports)
+                        == "jax.random.fold_in"
+                        and len(node.args) >= 2):
+                    helpers.add(fn.name)
+
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        self._derive_helpers = ctx.store.get("prng_derive_helpers", set())
+        for func in ast.walk(f.tree):
+            if not isinstance(func, FuncNode):
+                continue
+            seen: Set[Tuple[KeyId, int]] = set()
+            state = _ScopeState()
+            if not isinstance(func, ast.Lambda):
+                for a in (func.args.posonlyargs + func.args.args
+                          + func.args.kwonlyargs):
+                    ann = ""
+                    if a.annotation is not None and hasattr(ast, "unparse"):
+                        ann = ast.unparse(a.annotation)
+                    if _keyish(a.arg) and ann not in _SCALAR_ANNOTATIONS:
+                        state.tracked.add(a.arg)
+            body = ([func.body] if isinstance(func, ast.Lambda)
+                    else func.body)
+            self._walk_block(f, body, state, findings, seen)
+        return findings
+
+    # ------------------------------------------------------------------ walk
+    def _walk_block(self, f, stmts, state, findings, seen) -> None:
+        for stmt in stmts:
+            self._walk_stmt(f, stmt, state, findings, seen)
+
+    def _walk_stmt(self, f, stmt, state, findings, seen) -> None:
+        if isinstance(stmt, ast.If):
+            self._consume_expr(f, stmt.test, state, findings, seen)
+            b1, b2 = state.copy(), state.copy()
+            self._walk_block(f, stmt.body, b1, findings, seen)
+            self._walk_block(f, stmt.orelse, b2, findings, seen)
+            # a branch ending in return/raise never falls through — its
+            # consumptions must not merge into the post-if state
+            # (init_variables' early-return vmap path is the shape)
+            if _terminates(stmt.body):
+                b1 = b2
+            elif _terminates(stmt.orelse):
+                pass            # keep b1 only
+            else:
+                b1.merge_max(b2)
+            state.tracked, state.counts = b1.tracked, b1.counts
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._consume_expr(f, stmt.iter, state, findings, seen)
+            for _ in range(2):      # second pass: cross-iteration reuse
+                # the loop target is REBOUND fresh every iteration
+                self._bind_target(f, stmt.target, None, state)
+                self._walk_block(f, stmt.body, state, findings, seen)
+            self._walk_block(f, stmt.orelse, state, findings, seen)
+            return
+        if isinstance(stmt, ast.While):
+            self._consume_expr(f, stmt.test, state, findings, seen)
+            for _ in range(2):
+                self._walk_block(f, stmt.body, state, findings, seen)
+            self._walk_block(f, stmt.orelse, state, findings, seen)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_block(f, stmt.body, state, findings, seen)
+            for h in stmt.handlers:
+                self._walk_block(f, h.body, state.copy(), findings, seen)
+            self._walk_block(f, stmt.orelse, state, findings, seen)
+            self._walk_block(f, stmt.finalbody, state, findings, seen)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._consume_expr(f, item.context_expr, state, findings,
+                                   seen)
+            self._walk_block(f, stmt.body, state, findings, seen)
+            return
+        if isinstance(stmt, FuncNode):
+            return      # nested scope analyzed independently
+        if isinstance(stmt, ast.Assign):
+            self._consume_expr(f, stmt.value, state, findings, seen)
+            for t in stmt.targets:
+                self._bind_target(f, t, stmt.value, state)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._consume_expr(f, stmt.value, state, findings, seen)
+            self._bind_target(f, stmt.target, stmt.value, state)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._consume_expr(f, stmt.value, state, findings, seen)
+            return
+        # generic statement: consume loads in all contained expressions
+        self._consume_expr(f, stmt, state, findings, seen)
+
+    # ------------------------------------------------------------- bindings
+    def _is_producer(self, node, f) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        q = qualname(node.func, f.imports)
+        return q in _PRODUCERS
+
+    def _bind_target(self, f, target, value, state: _ScopeState) -> None:
+        names: List[str] = []
+        if isinstance(target, ast.Name):
+            names = [target.id]
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            names = [e.id for e in target.elts if isinstance(e, ast.Name)]
+        # producer RHS marks every target as a key regardless of its name
+        # (`a, b = jax.random.split(k)`); otherwise only key-ish names are
+        # tracked, so scalar plumbing never trips the rule.
+        producer = value is not None and self._is_producer(value, f)
+        nonkey = value is not None and self._is_nonkey_call(value, f)
+        for n in names:
+            state.rebind(n)
+            if producer or (_keyish(n) and not nonkey):
+                state.tracked.add(n)
+            elif nonkey:
+                # `rng = np.random.RandomState(seed)` and friends: a
+                # key-ish NAME holding a provably non-key VALUE
+                state.tracked.discard(n)
+
+    _PY_BUILTINS = {"sorted", "list", "dict", "set", "tuple", "frozenset",
+                    "zip", "enumerate", "range", "len", "str", "int",
+                    "float", "bool", "bytes", "map", "filter", "reversed",
+                    "sum", "min", "max", "open", "iter", "next", "getattr"}
+
+    def _is_nonkey_call(self, value, f) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        q = qualname(value.func, f.imports)
+        if not q:
+            return False
+        return (q.startswith("numpy.") or q == "numpy"
+                or ("." not in q and q in self._PY_BUILTINS))
+
+    # ---------------------------------------------------------- consumption
+    def _consume_expr(self, f, node, state, findings, seen) -> None:
+        if node is None:
+            return
+        exempt: Set[int] = set()     # id() of Name nodes not to count
+        counted_subscripts: Set[int] = set()
+
+        # producer-RHS tracking inside expressions: `k1, k2 = split(key)`
+        # is handled at statement level; here we only need the derivation
+        # exemption, non-consuming contexts, and subscript handling.
+        nodes = list(ast.walk(node))
+        for n in nodes:
+            # fold_in (or a project helper wrapping it, e.g. core/rng.py
+            # for_step) with NON-constant data derives a fresh key — the
+            # sanctioned reuse pattern
+            if isinstance(n, ast.Call) and len(n.args) >= 2 \
+                    and isinstance(n.args[0], ast.Name):
+                derive = (qualname(n.func, f.imports) == "jax.random.fold_in"
+                          or last_segment(n.func) in self._derive_helpers)
+                if derive and not isinstance(n.args[1], ast.Constant):
+                    exempt.add(id(n.args[0]))
+            # non-consuming contexts: a key NAME inside an f-string is
+            # logging; a name in a subscript INDEX is a dict lookup
+            if isinstance(n, ast.JoinedStr):
+                for sub in ast.walk(n):
+                    if isinstance(sub, ast.Name):
+                        exempt.add(id(sub))
+            if isinstance(n, ast.Subscript):
+                for sub in ast.walk(n.slice):
+                    if isinstance(sub, ast.Name):
+                        exempt.add(id(sub))
+        for n in nodes:
+            if not isinstance(n, ast.Subscript):
+                continue
+            base, idx = n.value, n.slice
+            if (isinstance(base, ast.Name) and base.id in state.tracked
+                    and isinstance(base.ctx, ast.Load)):
+                if isinstance(idx, ast.Constant):
+                    counted_subscripts.add(id(base))
+                    self._consume(f, n, (base.id, idx.value), state,
+                                  findings, seen)
+                else:
+                    exempt.add(id(base))   # dynamic index: unprovable
+        for n in nodes:
+            if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    and n.id in state.tracked
+                    and id(n) not in exempt
+                    and id(n) not in counted_subscripts):
+                self._consume(f, n, n.id, state, findings, seen)
+        # track producer assignments appearing as statement values was done
+        # in _bind_target; also track produce-into-keyish inside walrus:
+        for n in nodes:
+            if (isinstance(n, ast.NamedExpr)
+                    and isinstance(n.target, ast.Name)
+                    and (self._is_producer(n.value, f)
+                         or _keyish(n.target.id))):
+                state.rebind(n.target.id)
+                state.tracked.add(n.target.id)
+
+    def _consume(self, f, node, key_id: KeyId, state, findings, seen
+                 ) -> None:
+        c = state.counts.get(key_id, 0) + 1
+        state.counts[key_id] = c
+        if c == 2:
+            label = (key_id if isinstance(key_id, str)
+                     else f"{key_id[0]}[{key_id[1]!r}]")
+            if key_id not in seen:   # one finding per key per function
+                seen.add(key_id)
+                findings.append(self.finding(
+                    f, node, f"PRNG key {label!r} consumed a second time "
+                    "without an interposing jax.random.split/fold_in — "
+                    "identical randomness on both uses"))

@@ -1,0 +1,181 @@
+"""GL102 — recompile hazards.
+
+Three patterns that make XLA compile (or re-compile) far more than intended
+— the 45-minute compile wedge class of bug (RESULTS.md §1, core/remat.py
+docstring):
+
+(a) ``jax.jit`` called inside a loop body: every iteration builds a fresh
+    wrapper with its own cache, so nothing is ever reused;
+(b) an unhashable literal (list/dict/set/comprehension) passed in a static
+    position of a known-jitted callable: raises at best, and a
+    hashable-but-fresh object per call recompiles at worst — static args
+    must be hashable AND stable;
+(c) a jit-decorated function *nested in another function* closing over a
+    local bound to an array value: the array is baked into the executable
+    as a compile-time constant — silently stale when the enclosing function
+    produces a new value, and a re-trace per enclosing call.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graphlint.astutil import (ARRAY, ExprClassifier, FuncNode,
+                                     direct_body_walk, int_tuple_literal,
+                                     qualname, str_tuple_literal)
+from tools.graphlint.engine import Context, Finding, LintedFile, Rule
+
+_JIT_CALLS = {"jax.jit", "flax.linen.jit", "jax.pmap"}
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+def _is_jit_call(node: ast.AST, imports) -> bool:
+    return (isinstance(node, ast.Call)
+            and qualname(node.func, imports) in _JIT_CALLS)
+
+
+def _jit_static_spec(call: ast.Call
+                     ) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    nums: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = int_tuple_literal(kw.value) or ()
+        elif kw.arg == "static_argnames":
+            names = str_tuple_literal(kw.value) or ()
+    return nums, names
+
+
+class RecompileRule(Rule):
+    id = "GL102"
+    name = "recompile-hazard"
+    doc = ("jit-in-loop, unhashable static args, jitted closures over "
+           "array values")
+
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        findings += self._jit_in_loop(f)
+        findings += self._unhashable_static(f)
+        findings += self._array_closure(f)
+        return findings
+
+    # (a) ------------------------------------------------------------------
+    def _jit_in_loop(self, f: LintedFile) -> List[Finding]:
+        findings = []
+
+        def visit(node: ast.AST, loop_depth: int) -> None:
+            in_loop = loop_depth > 0
+            if in_loop and _is_jit_call(node, f.imports):
+                findings.append(self.finding(
+                    f, node, "jax.jit called inside a loop: each iteration "
+                    "builds a fresh wrapper with an empty compile cache; "
+                    "hoist the jit out of the loop"))
+            for child in ast.iter_child_nodes(node):
+                d = loop_depth + (1 if isinstance(
+                    node, (ast.For, ast.While, ast.AsyncFor))
+                    and child in (getattr(node, "body", []) or []) else 0)
+                visit(child, d)
+
+        visit(f.tree, 0)
+        return findings
+
+    # (b) ------------------------------------------------------------------
+    def _unhashable_static(self, f: LintedFile) -> List[Finding]:
+        findings = []
+        # jitted name -> (static positions, static names)
+        jitted: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {}
+        for node in ast.walk(f.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and _is_jit_call(node.value, f.imports)):
+                nums, names = _jit_static_spec(node.value)
+                if nums or names:
+                    jitted[node.targets[0].id] = (nums, names)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            spec = None
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in jitted):
+                spec = jitted[node.func.id]
+            elif _is_jit_call(node.func, f.imports):
+                # inline: jax.jit(fn, static_argnums=...)(args)
+                spec = _jit_static_spec(node.func)
+            if spec is None:
+                continue
+            nums, names = spec
+            for i, arg in enumerate(node.args):
+                if i in nums and isinstance(arg, _UNHASHABLE):
+                    findings.append(self.finding(
+                        f, arg, f"unhashable literal in static position "
+                        f"{i}: static args must be hashable and stable or "
+                        "every call re-traces (or TypeErrors)"))
+            for kw in node.keywords:
+                if kw.arg in names and isinstance(kw.value, _UNHASHABLE):
+                    findings.append(self.finding(
+                        f, kw.value, f"unhashable literal for static arg "
+                        f"{kw.arg!r}: static args must be hashable and "
+                        "stable or every call re-traces (or TypeErrors)"))
+        return findings
+
+    # (c) ------------------------------------------------------------------
+    def _array_closure(self, f: LintedFile) -> List[Finding]:
+        findings = []
+        for outer in ast.walk(f.tree):
+            if not isinstance(outer, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            cls = ExprClassifier.for_function(outer, f.imports)
+            # array-valued locals of the OUTER scope only: direct_body_walk
+            # skips nested function bodies, so an inner function's own
+            # locals (or a sibling's) never count as captures
+            for stmt in sorted(
+                    (s for s in direct_body_walk(outer)
+                     if isinstance(s, ast.Assign)),
+                    key=lambda s: (s.lineno, s.col_offset)):
+                cls.bind_assign(stmt)
+            array_locals = {n for n, k in cls.env.items() if k == ARRAY}
+            if not array_locals:
+                continue
+            for inner in ast.walk(outer):
+                if inner is outer or not isinstance(
+                        inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if not any(self._is_jit_decorator(d, f) for d in
+                           inner.decorator_list):
+                    continue
+                params = {a.arg for a in (inner.args.posonlyargs
+                                          + inner.args.args
+                                          + inner.args.kwonlyargs)}
+                # a name the inner function itself (re)binds is its own
+                # local, not a closure capture
+                inner_bound = {
+                    t.id for n in ast.walk(inner)
+                    if isinstance(n, ast.Assign) for t in n.targets
+                    if isinstance(t, ast.Name)}
+                captured = sorted(
+                    {n.id for n in ast.walk(inner)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)
+                     and n.id in array_locals and n.id not in params
+                     and n.id not in inner_bound})
+                for name in captured:
+                    findings.append(self.finding(
+                        f, inner, f"jitted closure captures array local "
+                        f"{name!r} from the enclosing function: it is "
+                        "baked in as a compile-time constant (stale on "
+                        "change, re-trace per enclosing call); pass it as "
+                        "an argument instead"))
+        return findings
+
+    def _is_jit_decorator(self, dec: ast.AST, f: LintedFile) -> bool:
+        q = qualname(dec, f.imports)
+        if q in _JIT_CALLS:
+            return True
+        if isinstance(dec, ast.Call):
+            fq = qualname(dec.func, f.imports)
+            if fq in _JIT_CALLS:
+                return True
+            if fq == "functools.partial" and dec.args:
+                return qualname(dec.args[0], f.imports) in _JIT_CALLS
+        return False

@@ -1,0 +1,232 @@
+"""GL105 — remat-tag coverage and drift.
+
+The named selective-remat policies (core/remat.py ``save_block_out`` /
+``offload_block_out``) key on ``checkpoint_name`` tags the model blocks
+must carry.  Lose the tag — a refactor drops ``tag_block_out``, or a typo
+renames the string — and the policy silently degrades to *save nothing*:
+the exact save-nothing backward graph that wedged XLA for 45 minutes at
+the bs1024 rung (ISSUE 2 motivation).  Nothing errors; throughput and
+compile time just quietly fall off a cliff.
+
+Cross-file invariants enforced:
+
+1. every block class reachable from a ``wrap_block``/``nn.remat`` call
+   (directly, or flowing through a ``block_cls=`` constructor kwarg) tags
+   its output with ``checkpoint_name`` or a tag-helper;
+2. every tag used by a model is declared by some names-based policy
+   (``save_only_these_names`` / ``save_and_offload_only_these_names``);
+3. every declared tag is used by at least one linted block/helper.
+
+The runtime complement (core/remat.py ``assert_tags_in_trace``) covers
+models assembled dynamically, where the AST cannot see the block class.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graphlint.astutil import (const_str, last_segment,
+                                     module_str_constants, qualname)
+from tools.graphlint.engine import (Context, Finding, Line, LintedFile,
+                                    Rule)
+
+_DECL_SAVE = "save_only_these_names"
+_DECL_OFFLOAD = "save_and_offload_only_these_names"
+_WRAP_NAMES = {"wrap_block"}
+_REMAT_QUALS = {"flax.linen.remat", "jax.checkpoint", "jax.remat",
+                "jax.ad_checkpoint.checkpoint"}
+_CKPT_NAME = "checkpoint_name"
+
+
+class _Store:
+    def __init__(self) -> None:
+        self.declared: Dict[str, Tuple[str, int]] = {}   # tag -> (file, line)
+        self.helpers: Dict[str, str] = {}                # helper fn -> tag
+        # rel -> {(class name, import-resolved qualname)} of wrap sites
+        self.candidates: Dict[str, Set[Tuple[str, str]]] = {}
+        self.class_tags: Dict[Tuple[str, str], Set[str]] = {}
+        self.used_tags: Set[str] = set()
+
+
+def _store(ctx: Context) -> _Store:
+    return ctx.store.setdefault("remat_tags", _Store())
+
+
+class RematTagRule(Rule):
+    id = "GL105"
+    name = "remat-tag-drift"
+    doc = ("block classes under a names-based remat policy must carry "
+           "matching checkpoint_name tags")
+
+    # ------------------------------------------------------------- phase 1
+    def collect(self, f: LintedFile, ctx: Context) -> None:
+        st = _store(ctx)
+        consts = module_str_constants(f.tree)
+
+        # declared tags from names-based policy constructors
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = last_segment(node.func)
+            if seg == _DECL_SAVE:
+                for a in node.args:
+                    tag = const_str(a, consts)
+                    if tag:
+                        st.declared.setdefault(tag, (f.rel, node.lineno))
+            elif seg == _DECL_OFFLOAD:
+                for kw in node.keywords:
+                    if kw.arg in ("names_which_can_be_saved",
+                                  "names_which_can_be_offloaded") and \
+                            isinstance(kw.value, (ast.List, ast.Tuple)):
+                        for e in kw.value.elts:
+                            tag = const_str(e, consts)
+                            if tag:
+                                st.declared.setdefault(
+                                    tag, (f.rel, node.lineno))
+
+        # tag helpers: module functions whose body calls checkpoint_name
+        for fn in f.tree.body:
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and last_segment(node.func) == _CKPT_NAME
+                        and len(node.args) >= 2):
+                    tag = const_str(node.args[1], consts)
+                    if tag:
+                        st.helpers[fn.name] = tag
+                        st.used_tags.add(tag)
+
+        # block-class candidates: direct wrap args + block_cls= flow
+        assigns: Dict[str, ast.AST] = {}
+        for node in ast.walk(f.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                assigns[node.targets[0].id] = node.value
+
+        def class_names_of(value: ast.AST) -> Set[str]:
+            if isinstance(value, ast.Name):
+                if value.id in assigns:
+                    return class_names_of(assigns[value.id])
+                return {value.id}
+            if isinstance(value, ast.IfExp):
+                return class_names_of(value.body) | class_names_of(
+                    value.orelse)
+            return set()
+
+        cands = st.candidates.setdefault(f.rel, set())
+
+        def record(names: Set[str]) -> None:
+            # keep the wrap site's view of WHERE the class comes from: a
+            # locally-defined class resolves to its bare name, an imported
+            # one to a dotted path — check() uses this so same-named
+            # classes in other modules are never falsely judged
+            for n in names:
+                cands.add((n, f.imports.resolve(n)))
+
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = last_segment(node.func)
+            q = qualname(node.func, f.imports)
+            if (seg in _WRAP_NAMES or q in _REMAT_QUALS) and node.args:
+                record(class_names_of(node.args[0]))
+            for kw in node.keywords:
+                if kw.arg == "block_cls":
+                    record(class_names_of(kw.value))
+
+        # tags used inside class bodies
+        for cls in f.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            tags: Set[str] = set()
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Call):
+                    continue
+                seg = last_segment(node.func)
+                if seg == _CKPT_NAME and len(node.args) >= 2:
+                    tag = const_str(node.args[1], consts)
+                    if tag:
+                        tags.add(tag)
+                elif seg is not None:
+                    # helper calls resolved in phase 2 (helpers may live in
+                    # a file collected later); record the call name
+                    tags.add(f"call:{seg}")
+            st.class_tags[(f.rel, cls.name)] = tags
+
+    # ------------------------------------------------------------- phase 2
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        st = _store(ctx)
+        findings: List[Finding] = []
+
+        # resolve helper-call markers now that all helpers are known
+        def resolved(tags: Set[str]) -> Set[str]:
+            out = set()
+            for t in tags:
+                if t.startswith("call:"):
+                    helper = st.helpers.get(t[len("call:"):])
+                    if helper:
+                        out.add(helper)
+                else:
+                    out.add(t)
+            return out
+
+        class_lines = {c.name: c.lineno for c in f.tree.body
+                       if isinstance(c, ast.ClassDef)}
+        local_classes = {c.name for c in f.tree.body
+                         if isinstance(c, ast.ClassDef)}
+
+        # candidates may be declared in one module and wrapped in another;
+        # judge a class in the module that DEFINES it.  A bare (undotted)
+        # candidate is the wrapping file's own local class, so it only
+        # matches when that file IS this file; a dotted candidate (wrap of
+        # an imported class) matches this file's module path — never a
+        # same-named class in an unrelated module.
+        this_module = f.rel[:-3].replace(os.sep, ".").replace("/", ".") \
+            if f.rel.endswith(".py") else f.rel
+        wrapped_here: Set[str] = set()
+        for rel, cands in st.candidates.items():
+            for name, origin in cands:
+                if name not in local_classes:
+                    continue
+                qual = f"{this_module}.{name}"
+                if rel == f.rel and origin == name:
+                    wrapped_here.add(name)
+                elif "." in origin and (qual == origin
+                                        or qual.endswith("." + origin)):
+                    wrapped_here.add(name)
+
+        for cls_name in sorted(wrapped_here):
+            tags = resolved(st.class_tags.get((f.rel, cls_name), set()))
+            st.used_tags |= tags
+            node_line = class_lines.get(cls_name, 0)
+            anchor = Line(node_line)
+            if not tags:
+                findings.append(self.finding(
+                    f, anchor, f"block class {cls_name!r} is wrapped by a "
+                    "remat policy but carries no checkpoint_name tag: the "
+                    "names-based policies (save_block_out/"
+                    "offload_block_out) would silently save nothing"))
+            elif st.declared:
+                for tag in sorted(tags - set(st.declared)):
+                    findings.append(self.finding(
+                        f, anchor, f"block class {cls_name!r} tags "
+                        f"{tag!r}, which no names-based remat policy "
+                        f"declares (declared: "
+                        f"{sorted(st.declared)}) — tag drift"))
+
+        # declared-but-unused: emitted once, at the declaration site
+        for tag, (rel, line) in sorted(st.declared.items()):
+            if rel != f.rel:
+                continue
+            used = st.used_tags | set().union(
+                *(resolved(t) for t in st.class_tags.values())) \
+                if st.class_tags else st.used_tags
+            if tag not in used:
+                findings.append(self.finding(
+                    f, Line(line), f"remat policy declares tag {tag!r} "
+                    "but no linted block or helper ever applies it — the "
+                    "policy saves nothing"))
+        return findings
+
