@@ -52,7 +52,16 @@ Usage:
   python bench.py --dry-compile    # AOT-compile ONE accumulation config
                                    #   (default: effective 4096 @ microbatch
                                    #   256, --remat-policy dots) and report
-                                   #   memory_analysis() without executing
+                                   #   memory_analysis() without executing;
+                                   #   --augment-placement loader|step picks
+                                   #   the input contract (float32 views vs
+                                   #   raw uint8 + in-step augmentation)
+  python bench.py --input-ladder   # augment-placement A/B: loader-aug
+                                   #   float32 vs step-aug uint8 at effective
+                                   #   512/1024/4096 @ microbatch 256; every
+                                   #   row records h2d_bytes_per_step + HBM
+                                   #   high-water (same compile gating as
+                                   #   --accum-ladder)
 """
 from __future__ import annotations
 
@@ -145,7 +154,8 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
            fuse_views: bool, ema_update_mode: str, remat: bool = False,
            stem: str = "conv", attn_impl: str = "dense",
            accum_steps: int = 1, accum_bn_mode: str = "average",
-           remat_policy: str = "none", materialize_batch: bool = True):
+           remat_policy: str = "none", augment_placement: str = "loader",
+           materialize_batch: bool = True):
     from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
                                       OptimConfig, ParityConfig, TaskConfig,
                                       resolve)
@@ -156,7 +166,8 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
     mesh = build_mesh(MeshSpec(data=n_dev))
     cfg = Config(
         task=TaskConfig(task="fake", batch_size=batch_size * n_dev, epochs=100,
-                        image_size_override=image_size),
+                        image_size_override=image_size,
+                        augment_placement=augment_placement),
         model=ModelConfig(arch=arch, fuse_views=fuse_views, remat=remat,
                           remat_policy=remat_policy,
                           stem=stem, attn_impl=attn_impl),
@@ -174,21 +185,41 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
     b = cfg.task.batch_size
     if not materialize_batch:
         # Compile-only paths lower against shapes + shardings; no pixels.
-        return state, train_step, _abstract_batch(b, image_size, mesh), mesh
+        return (state, train_step,
+                _abstract_batch(b, image_size, mesh,
+                                augment_placement=augment_placement), mesh)
     # fp32-native generation: RandomState.rand materializes a float64
     # intermediate, which at the effective-4096 rung is a ~40 GB host
     # transient PER VIEW — enough to OOM the 1-core TPU host before the
     # measurement starts.
     rng = np.random.default_rng(0)
-    batch = {
-        "view1": rng.random((b, image_size, image_size, 3),
-                            dtype=np.float32),
-        "view2": rng.random((b, image_size, image_size, 3),
-                            dtype=np.float32),
-        "label": rng.integers(0, 1000, size=(b,)).astype(np.int32),
-    }
+    if augment_placement == "step":
+        # raw-uint8 contract (loader._raw_pipeline): the step augments
+        batch = {
+            "images": rng.integers(0, 256, (b, image_size, image_size, 3),
+                                   dtype=np.uint8),
+            "label": rng.integers(0, 1000, size=(b,)).astype(np.int32),
+        }
+    else:
+        batch = {
+            "view1": rng.random((b, image_size, image_size, 3),
+                                dtype=np.float32),
+            "view2": rng.random((b, image_size, image_size, 3),
+                                dtype=np.float32),
+            "label": rng.integers(0, 1000, size=(b,)).astype(np.int32),
+        }
     batch = shard_batch_to_mesh(batch, mesh)
     return state, train_step, batch, mesh
+
+
+def _batch_h2d_bytes(batch) -> int:
+    """Host bytes one step's input batch ships over PCIe/H2D — works for
+    concrete arrays and for the compile-only ShapeDtypeStruct batches.
+    ONE implementation shared with the trainer's input meter
+    (data/prefetch.py host_nbytes), so the bench column and the epoch log
+    can never disagree."""
+    from byol_tpu.data.prefetch import host_nbytes
+    return host_nbytes(batch)
 
 
 def _aot_compile(train_step, state, batch, mesh):
@@ -203,7 +234,8 @@ def _aot_compile(train_step, state, batch, mesh):
     t0 = time.perf_counter()
     with mesh:
         compiled = fn.lower(state, batch).compile()
-    stats = {"compile_seconds": round(time.perf_counter() - t0, 2)}
+    stats = {"compile_seconds": round(time.perf_counter() - t0, 2),
+             "h2d_bytes_per_step": _batch_h2d_bytes(batch)}
     stats.update(_memory_stats(compiled))
     return compiled, stats
 
@@ -212,14 +244,16 @@ def _throughput(batch_size: int, image_size: int, arch: str, *, half: bool,
                 fuse_views: bool, ema_update_mode: str, remat: bool = False,
                 stem: str = "conv", attn_impl: str = "dense",
                 accum_steps: int = 1, accum_bn_mode: str = "average",
-                remat_policy: str = "none", steps: int = 20) -> _Rate:
+                remat_policy: str = "none",
+                augment_placement: str = "loader", steps: int = 20) -> _Rate:
     """Images/sec/chip for one configuration (global images / sec / n_dev);
     the returned float carries compile/HBM stats (``_Rate.stats``)."""
     state, train_step, batch, mesh = _build(
         batch_size, image_size, arch, half=half, fuse_views=fuse_views,
         ema_update_mode=ema_update_mode, remat=remat, stem=stem,
         attn_impl=attn_impl, accum_steps=accum_steps,
-        accum_bn_mode=accum_bn_mode, remat_policy=remat_policy)
+        accum_bn_mode=accum_bn_mode, remat_policy=remat_policy,
+        augment_placement=augment_placement)
     compiled, stats = _aot_compile(train_step, state, batch, mesh)
     # warmup: 3 steady steps.  NB: sync via a scalar READBACK, not
     # block_until_ready — on tunneled platforms (axon) block_until_ready
@@ -477,7 +511,7 @@ def main():
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     if not _preflight_backend():
         mode = {"--sweep", "--profile", "--stem-ab", "--mvc",
-                "--accum-ladder", "--dry-compile"} \
+                "--accum-ladder", "--dry-compile", "--input-ladder"} \
             & set(sys.argv[1:])
         if mode:
             # only the headline has a committed artifact to fall back to;
@@ -488,12 +522,17 @@ def main():
                 "live hardware (no stale fallback for non-headline modes)")
         _emit_stale_or_die()
         return
-    accum_gates = None
-    if "--accum-ladder" in sys.argv[1:]:
+    accum_gates = input_gates = None
+    if "--accum-ladder" in sys.argv[1:] or "--input-ladder" in sys.argv[1:]:
         # Gate children must claim the single-client TPU before the
         # in-process backend init below pins it to this process.
-        accum_gates = _accum_gate_phase(_probe_backend_is_accel(),
-                                        arch_override, attn_impl)
+        is_accel = _probe_backend_is_accel()
+        if "--accum-ladder" in sys.argv[1:]:
+            accum_gates = _accum_gate_phase(is_accel, arch_override,
+                                            attn_impl)
+        if "--input-ladder" in sys.argv[1:]:
+            input_gates = _input_gate_phase(is_accel, arch_override,
+                                            attn_impl)
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
         arch, image_size = arch_override or "resnet50", 224
@@ -595,6 +634,10 @@ def main():
     if "--accum-ladder" in sys.argv[1:]:
         _accum_ladder(arch, image_size, on_tpu, mfu_of, attn_impl,
                       accum_gates)
+        return
+    if "--input-ladder" in sys.argv[1:]:
+        _input_ladder(arch, image_size, on_tpu, mfu_of, attn_impl,
+                      input_gates)
         return
 
     value = best_throughput("tpu_first", half=True, fuse_views=True,
@@ -998,13 +1041,20 @@ def _str_flag(name: str, default: str) -> str:
 _V5E_HBM_BYTES = 16 * 2 ** 30            # the budget the ladder reports against
 
 
-def _abstract_batch(batch_size: int, image_size: int, mesh):
+def _abstract_batch(batch_size: int, image_size: int, mesh,
+                    augment_placement: str = "loader"):
     """ShapeDtypeStruct batch for compile-only paths: lowering needs shapes
     and shardings, not 5 GB of host random pixels."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from byol_tpu.parallel.mesh import DATA_AXIS
     sh = NamedSharding(mesh, P(DATA_AXIS))
     b = batch_size
+    if augment_placement == "step":
+        return {
+            "images": jax.ShapeDtypeStruct((b, image_size, image_size, 3),
+                                           np.uint8, sharding=sh),
+            "label": jax.ShapeDtypeStruct((b,), np.int32, sharding=sh),
+        }
     return {
         "view1": jax.ShapeDtypeStruct((b, image_size, image_size, 3),
                                       np.float32, sharding=sh),
@@ -1030,8 +1080,12 @@ def _dry_compile(arch, image_size, on_tpu, attn_impl):
     mb = _int_flag("--microbatch", 256 if on_tpu else 16)
     policy = _str_flag("--remat-policy", "dots")
     bn_mode = _str_flag("--accum-bn-mode", "average")
+    placement = _str_flag("--augment-placement", "loader")
     from byol_tpu.core.remat import validate_policy
     validate_policy(policy)                  # fail fast on typos
+    if placement not in ("loader", "step"):
+        raise SystemExit(
+            "usage: bench.py ... --augment-placement loader|step")
     if eff % mb:
         raise SystemExit(
             f"bench: effective batch {eff} not divisible by microbatch {mb}")
@@ -1043,7 +1097,8 @@ def _dry_compile(arch, image_size, on_tpu, attn_impl):
     state, train_step, batch, mesh = _build(
         eff, image_size, arch, half=True, fuse_views=True,
         ema_update_mode="post", attn_impl=attn_impl, accum_steps=accum,
-        accum_bn_mode=bn_mode, remat_policy=policy, materialize_batch=False)
+        accum_bn_mode=bn_mode, remat_policy=policy,
+        augment_placement=placement, materialize_batch=False)
     compiled, stats = _aot_compile(train_step, state, batch, mesh)
     del compiled
     hbm = stats.get("hbm_high_water_bytes")
@@ -1058,6 +1113,7 @@ def _dry_compile(arch, image_size, on_tpu, attn_impl):
         "accum_steps": accum,
         "remat_policy": policy,
         "accum_bn_mode": bn_mode,
+        "augment_placement": placement,
         "device_kind": jax.devices()[0].device_kind,
         "under_v5e_16gib": (None if hbm is None
                             else bool(hbm < _V5E_HBM_BYTES)),
@@ -1101,8 +1157,8 @@ def _probe_backend_is_accel(timeout_s: float = 180.0) -> bool:
     return bool(out) and out[-1] != "cpu"
 
 
-def _accum_gate_phase(on_tpu, arch_override, attn_impl):
-    """Run every accumulation-ladder compile gate in a killable subprocess
+def _run_compile_gates(rungs, timeout):
+    """Run each rung's ``--dry-compile`` gate in a killable subprocess
     BEFORE the parent initializes its own backend client.
 
     Ordering is load-bearing on TPU: the backend is single-process-
@@ -1114,23 +1170,15 @@ def _accum_gate_phase(on_tpu, arch_override, attn_impl):
     and leaving its compile in the persistent cache, which makes the
     parent's measurement compile nearly free.
 
-    Returns ``{rung_name: {"status": "ok"|"timeout"|"error", ...}}`` for
-    :func:`_accum_ladder` to consume after the parent initializes.
+    ``rungs``: ``[(rung_name, extra_dry_compile_argv)]``.  Returns
+    ``{rung_name: {"status": "ok"|"timeout"|"error", ...}}`` for the
+    ladder to consume after the parent initializes.
     """
     import subprocess
-    mb, policy, bn_mode, timeout, effectives = _accum_flags(on_tpu)
     gates = {}
-    for eff in effectives:
-        name = f"accum_eff{eff}_mb{mb}_{policy}"
+    for name, extra in rungs:
         gate_cmd = [sys.executable, os.path.abspath(__file__),
-                    "--dry-compile", "--effective-batch", str(eff),
-                    "--microbatch", str(mb), "--remat-policy", policy,
-                    "--accum-bn-mode", bn_mode, "--attn", attn_impl]
-        if arch_override:
-            # The gate must compile the SAME model the ladder measures: an
-            # un-forwarded --arch would wedge-protect the default arch
-            # while the parent compiled the overridden one unprotected.
-            gate_cmd += ["--arch", arch_override]
+                    "--dry-compile"] + extra
         try:
             gate = subprocess.run(gate_cmd, timeout=timeout,
                                   capture_output=True, text=True)
@@ -1149,6 +1197,41 @@ def _accum_gate_phase(on_tpu, arch_override, attn_impl):
             row = {}
         gates[name] = {"status": "ok", "row": row}
     return gates
+
+
+def _gate_args(eff, mb, policy, bn_mode, attn_impl, arch_override,
+               placement="loader"):
+    """argv for one --dry-compile gate child; the gate must compile the
+    SAME model the ladder measures (an un-forwarded --arch would
+    wedge-protect the default arch while the parent compiled the
+    overridden one unprotected)."""
+    extra = ["--effective-batch", str(eff), "--microbatch", str(mb),
+             "--remat-policy", policy, "--accum-bn-mode", bn_mode,
+             "--attn", attn_impl, "--augment-placement", placement]
+    if arch_override:
+        extra += ["--arch", arch_override]
+    return extra
+
+
+def _accum_gate_phase(on_tpu, arch_override, attn_impl):
+    """Compile gates for the accumulation ladder (see _run_compile_gates)."""
+    mb, policy, bn_mode, timeout, effectives = _accum_flags(on_tpu)
+    rungs = [(f"accum_eff{eff}_mb{mb}_{policy}",
+              _gate_args(eff, mb, policy, bn_mode, attn_impl, arch_override))
+             for eff in effectives]
+    return _run_compile_gates(rungs, timeout)
+
+
+def _input_gate_phase(on_tpu, arch_override, attn_impl):
+    """Compile gates for the input-pipeline ladder: BOTH placements per
+    effective-batch rung (loader-aug float32 views vs step-aug uint8)."""
+    mb, policy, bn_mode, timeout, effectives = _accum_flags(on_tpu)
+    rungs = [(f"input_eff{eff}_mb{mb}_{placement}",
+              _gate_args(eff, mb, policy, bn_mode, attn_impl, arch_override,
+                         placement))
+             for eff in effectives
+             for placement in ("loader", "step")]
+    return _run_compile_gates(rungs, timeout)
 
 
 def _accum_ladder(arch, image_size, on_tpu, mfu_of, attn_impl, gates):
@@ -1223,6 +1306,82 @@ def _accum_ladder(arch, image_size, on_tpu, mfu_of, attn_impl, gates):
               f"compile={row.get('compile_seconds')}s "
               f"hbm={row.get('hbm_high_water_bytes')}", file=sys.stderr)
     print(json.dumps({"metric": "accum_ladder", "value": len(rungs),
+                      "unit": "rungs", "vs_baseline": None,
+                      "microbatch_per_chip": mb, "remat_policy": policy,
+                      "rungs": rungs,
+                      "complete": not _backend_dead}))
+    if _backend_dead:
+        raise SystemExit(3)   # same truncation contract as --sweep
+
+
+def _input_ladder(arch, image_size, on_tpu, mfu_of, attn_impl, gates):
+    """Input-pipeline ladder (``--input-ladder``): loader-placement
+    (two float32 views shipped from the host) vs step-placement (raw uint8
+    shipped, views materialized per microbatch inside the accumulation
+    scan) at effective 512/1024/4096 per chip @ microbatch 256 — the
+    augment-placement A/B ISSUE 3 exists for.
+
+    Every row records ``h2d_bytes_per_step`` (the ~8x payload difference),
+    ``hbm_high_water_bytes`` (step placement must be strictly lower: only
+    one microbatch of views is ever live), ``compile_seconds`` and
+    img/s/chip.  Same killable-subprocess compile gating as the
+    accumulation ladder (:func:`_input_gate_phase` ran BEFORE this process
+    claimed the backend).
+    """
+    mb, policy, bn_mode, timeout, effectives = _accum_flags(on_tpu)
+    timing_steps = 10 if on_tpu else 3
+    rungs = []
+    grid = [(eff, placement) for eff in effectives
+            for placement in ("loader", "step")]
+    for eff, placement in grid:
+        if _backend_dead:
+            break
+        accum = eff // mb
+        name = f"input_eff{eff}_mb{mb}_{placement}"
+        tags = {"effective_batch_per_chip": eff, "microbatch_per_chip": mb,
+                "accum_steps": accum, "remat_policy": policy,
+                "augment_placement": placement}
+        gate = gates.get(name) or {"status": "error",
+                                   "err": "no gate result for this rung"}
+        if gate["status"] == "timeout":
+            _record(name, fit=False, **tags,
+                    error=f"compile-timeout gate: exceeded {timeout}s "
+                          "(wedged-compile signature; subprocess killed)")
+            continue
+        if gate["status"] == "error":
+            err = gate["err"]
+            if _config_failed(f"input gate {name}", RuntimeError(err)):
+                break
+            _record(name, fit=False, **tags,
+                    error=f"gate subprocess: {err}")
+            continue
+        gate_row = gate.get("row", {})
+        try:
+            val = _throughput(eff, image_size, arch, half=True,
+                              fuse_views=True, ema_update_mode="post",
+                              attn_impl=attn_impl, accum_steps=accum,
+                              accum_bn_mode=bn_mode, remat_policy=policy,
+                              augment_placement=placement,
+                              steps=timing_steps)
+        except Exception as e:
+            if _config_failed(f"input ladder {name}", e):
+                break
+            _record(name, fit=False, **tags, error=repr(e)[:300],
+                    gate_hbm_high_water_bytes=gate_row.get(
+                        "hbm_high_water_bytes"))
+            continue
+        row = {**tags, "accum_bn_mode": bn_mode,
+               "images_per_sec_per_chip": round(val, 2),
+               "mfu": mfu_of(val), **_row_stats(val)}
+        if "hbm_high_water_bytes" not in row and gate_row:
+            row["hbm_high_water_bytes"] = gate_row.get(
+                "hbm_high_water_bytes")
+        rungs.append(row)
+        _record(name, fit=True, **row)
+        print(f"bench: {name}: {float(val):.1f} img/s/chip "
+              f"h2d={row.get('h2d_bytes_per_step')} "
+              f"hbm={row.get('hbm_high_water_bytes')}", file=sys.stderr)
+    print(json.dumps({"metric": "input_ladder", "value": len(rungs),
                       "unit": "rungs", "vs_baseline": None,
                       "microbatch_per_chip": mb, "remat_policy": policy,
                       "rungs": rungs,

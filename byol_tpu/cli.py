@@ -204,6 +204,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="augmentation pipeline: tf.data host, native C++ "
                         "host kernel, or on-chip jitted augmentation "
                         "(both DALI analogs; 'device' ships uint8 to HBM)")
+    x.add_argument("--augment-placement", type=str, default="loader",
+                   choices=("loader", "step"),
+                   help="where two-view train augmentation runs: 'loader' "
+                        "= the train iterator yields float32 views; 'step' "
+                        "= the loader ships RAW uint8 batches (~8x fewer "
+                        "H2D bytes at 224px) and the jitted train step "
+                        "augments per microbatch INSIDE the accumulation "
+                        "scan (one microbatch of views live in HBM, no "
+                        "separate augment dispatch)")
     x.add_argument("--loss-norm-mode", type=str, default="paper",
                    choices=("paper", "reference"), help="Quirk Q2 switch")
     x.add_argument("--ema-init-mode", type=str, default="copy",
@@ -249,6 +258,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             log_dir=args.log_dir, uid=args.uid,
             grapher=args.grapher,
             data_backend=args.data_backend,
+            augment_placement=args.augment_placement,
             num_synth_samples=args.num_synth_samples,
             valid_fraction=args.valid_fraction),
         model=ModelConfig(

@@ -41,6 +41,17 @@ class TaskConfig:
     # jitted two-view augmentation, data/device_augment.py).  The latter two
     # are the DALI equivalents (reference main.py:356-382).
     data_backend: str = "tf"
+    # Where the two-view train augmentation runs:
+    # - 'loader': the train iterator yields materialized float32 views
+    #   (whatever backend produced them) — ~8x the H2D bytes of the raw
+    #   pixels at 224px (two float32 views per uint8 image).
+    # - 'step'  : the train iterator yields RAW uint8 batches
+    #   ({'images': (B,H,W,C) uint8, 'label': (B,)}) and the jitted train
+    #   step derives per-microbatch PRNG keys from state.step and runs
+    #   device_augment inside the accumulation scan — only ONE microbatch
+    #   of float32 views is ever live in HBM and the separate augment
+    #   dispatch disappears (training/steps.py).
+    augment_placement: str = "loader"
     # Dataset size for the offline-learnable 'synth' task (test split is
     # 1/10th); committed evidence runs use this to stay reproducible from
     # the CLI alone.  0 = loader default (20k).
@@ -291,6 +302,10 @@ def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
         raise ValueError(
             f"unknown accum_bn_mode {cfg.optim.accum_bn_mode!r}; "
             "'average' | 'microbatch' | 'global'")
+    if cfg.task.augment_placement not in ("loader", "step"):
+        raise ValueError(
+            f"unknown augment_placement {cfg.task.augment_placement!r}; "
+            "'loader' | 'step'")
     from byol_tpu.core.remat import resolve_policy_name
     resolve_policy_name(cfg.model.remat, cfg.model.remat_policy)  # fail fast
     per_replica_batch = cfg.task.batch_size // n_rep

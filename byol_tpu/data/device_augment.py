@@ -136,15 +136,16 @@ def augment_one(key, image: jnp.ndarray, size: int,
     return jnp.clip(v, 0.0, 1.0)
 
 
-@functools.partial(jax.jit, static_argnums=(2,), static_argnames=("strength",))
-def two_view_batch(key, images: jnp.ndarray, size: int, *,
-                   strength: float = 1.0
-                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Batched two-view augmentation on device.
+def two_view(key, images: jnp.ndarray, size: int, *,
+             strength: float = 1.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Traceable batched two-view program — the ONE augmentation function
+    behind both placements (core/config.py ``augment_placement``): the
+    loader path jit-dispatches it standalone (:func:`two_view_batch`) and
+    the step-fused path traces it per microbatch inside the train step
+    (training/steps.py), so identical keys provably yield identical views.
 
     images: (B, H, W, C) uint8 or float32 [0,1] -> two (B, size, size, C)
-    float32 views.  uint8 in, so the host→HBM transfer is 4x smaller than
-    shipping floats (the DALI-style bandwidth win).
+    float32 views.
     """
     if images.dtype == jnp.uint8:
         images = images.astype(jnp.float32) / 255.0
@@ -154,3 +155,13 @@ def two_view_batch(key, images: jnp.ndarray, size: int, *,
     v1 = aug(jax.random.split(k1, b), images)
     v2 = aug(jax.random.split(k2, b), images)
     return v1, v2
+
+
+@functools.partial(jax.jit, static_argnums=(2,), static_argnames=("strength",))
+def two_view_batch(key, images: jnp.ndarray, size: int, *,
+                   strength: float = 1.0
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Standalone jitted dispatch of :func:`two_view` — the loader-placement
+    backend (``--data-backend device``).  uint8 in, so the host→HBM transfer
+    is 4x smaller than shipping floats (the DALI-style bandwidth win)."""
+    return two_view(key, images, size, strength=strength)

@@ -259,6 +259,36 @@ def _device_pipeline(images: np.ndarray, labels: np.ndarray, *,
     return make
 
 
+def _raw_pipeline(images: np.ndarray, labels: np.ndarray, *,
+                  batch_size: int, seed: int, shuffle: bool
+                  ) -> Callable[[int], Iterator[Batch]]:
+    """Step-placement train pipeline: raw uint8 batches, no host-side
+    augmentation at all (``augment_placement='step'``).
+
+    Yields ``{'images': (B,H,W,C) uint8, 'label': (B,) int32}`` — the train
+    step derives per-microbatch keys from its step counter and augments
+    inside the accumulation scan (training/steps.py).  ~8x fewer H2D bytes
+    than two float32 views, and the host's per-batch work collapses to an
+    index gather."""
+    labels = labels.astype(np.int32)
+    if images.dtype != np.uint8:
+        raise ValueError(
+            f"augment_placement='step' ships raw uint8 pixels; this dataset "
+            f"holds {images.dtype} arrays")
+
+    def make(epoch: int) -> Iterator[Batch]:
+        idx = np.arange(len(labels))
+        if shuffle:
+            np.random.RandomState(seed + epoch).shuffle(idx)
+        n = len(idx)
+        end = n - (n % batch_size)
+        for lo in range(0, end, batch_size):
+            take = idx[lo:lo + batch_size]
+            yield {"images": images[take], "label": labels[take]}
+
+    return make
+
+
 def get_loader(cfg: Config, *, num_fake_samples: int = 512,
                num_synth_samples: Optional[int] = None,
                shard_eval: bool = False) -> LoaderBundle:
@@ -306,6 +336,26 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
         raise ValueError(
             f"aug_spec={cfg.regularizer.aug_spec!r} is implemented on the "
             f"tf data backend only (got data_backend={backend!r})")
+    placement = cfg.task.augment_placement
+    if placement not in ("loader", "step"):
+        raise ValueError(f"unknown augment_placement {placement!r} "
+                         f"('loader'|'step')")
+    if placement == "step":
+        if task == "image_folder":
+            raise ValueError(
+                "augment_placement='step' does not serve image_folder: "
+                "decode is host-side and yields variable-size images; use "
+                "the loader placement")
+        if cfg.regularizer.aug_spec != "reference":
+            raise ValueError(
+                f"augment_placement='step' runs the canonical 'reference' "
+                f"augmentation spec on device (got "
+                f"aug_spec={cfg.regularizer.aug_spec!r})")
+        if backend == "device":
+            raise ValueError(
+                "data_backend='device' (loader-dispatched on-chip augment) "
+                "and augment_placement='step' (step-fused augment) are "
+                "mutually exclusive; pick one")
 
     if task == "image_folder":
         if backend == "device":
@@ -376,10 +426,17 @@ def get_loader(cfg: Config, *, num_fake_samples: int = 512,
         # on-chip train augmentation; eval resize stays on host (its
         # throughput never gates the MXU)
         pipeline, test_pipeline = _device_pipeline, _array_pipeline
-    return LoaderBundle(
-        make_train_iter=pipeline(
+    if placement == "step":
+        # raw uint8 train stream (the step augments); eval keeps the host
+        # resize path of whatever backend resolved above
+        make_train = _raw_pipeline(x_trs, y_trs, batch_size=host_batch,
+                                   seed=cfg.device.seed, shuffle=True)
+    else:
+        make_train = pipeline(
             x_trs, y_trs, batch_size=host_batch, image_size=size, train=True,
-            color_jitter_strength=cj, seed=cfg.device.seed, shuffle=True),
+            color_jitter_strength=cj, seed=cfg.device.seed, shuffle=True)
+    return LoaderBundle(
+        make_train_iter=make_train,
         make_test_iter=test_pipeline(
             x_te, y_te, batch_size=host_batch, image_size=size, train=False,
             color_jitter_strength=cj, seed=cfg.device.seed, shuffle=False),

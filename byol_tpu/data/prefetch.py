@@ -29,10 +29,13 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Iterator
+import time
+from typing import Iterator, Optional
 
+import numpy as np
 from jax.sharding import Mesh
 
+from byol_tpu.observability.meters import InputPipelineMeter
 from byol_tpu.parallel.mesh import shard_batch_to_mesh
 
 _END = object()          # producer sentinel: source iterator exhausted
@@ -45,9 +48,42 @@ class _Failure:
         self.exc = exc
 
 
-def prefetch_to_mesh(iterator: Iterator, mesh: Mesh, size: int = 2
+def _leaf_nbytes(v) -> int:
+    """Byte size from ARRAY METADATA only — never materializes the value.
+    ``np.asarray`` here would force a blocking D2H copy when the loader
+    yields device arrays (the ``data_backend='device'`` path), serializing
+    the very pipeline this module double-buffers."""
+    nbytes = getattr(v, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    return int(np.prod(np.shape(v))) * np.dtype(
+        getattr(v, "dtype", np.float32)).itemsize
+
+
+def host_nbytes(batch) -> int:
+    """Bytes one batch ships into the prefetch pipeline — the H2D payload
+    the input-pipeline meter reports (uint8 raw batches are ~8x smaller
+    than two float32 views at 224px; the meter makes that visible per
+    run).  Caveat: with ``data_backend='device'`` the loader's batches are
+    already device-resident views, so this counts the view payload rather
+    than the smaller uint8 transfer the augment dispatch made — still
+    metadata-only, no copy.  Shared with bench.py's per-row
+    ``h2d_bytes_per_step`` so the two surfaces cannot drift."""
+    if isinstance(batch, dict):
+        return sum(_leaf_nbytes(v) for v in batch.values())
+    return _leaf_nbytes(batch)
+
+
+def prefetch_to_mesh(iterator: Iterator, mesh: Mesh, size: int = 2,
+                     meter: Optional[InputPipelineMeter] = None
                      ) -> Iterator:
-    """Yield device-resident batches, keeping up to ``size`` in flight."""
+    """Yield device-resident batches, keeping up to ``size`` in flight.
+
+    ``meter`` (observability.meters.InputPipelineMeter): when given, the
+    producer records each batch's host-byte payload + the queue depth it
+    leaves, and the consumer records its blocking wait for the next batch
+    (time-to-next-batch / starvation) — the input-pipeline health surface
+    the trainer prints per epoch."""
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
     # ``slots`` — not the queue's maxsize — is what bounds device residency:
@@ -71,7 +107,10 @@ def prefetch_to_mesh(iterator: Iterator, mesh: Mesh, size: int = 2
                         return
                 if stop.is_set():
                     return
+                nbytes = host_nbytes(batch) if meter is not None else 0
                 q.put(shard_batch_to_mesh(batch, mesh))
+                if meter is not None:
+                    meter.record_produced(nbytes, q.qsize())
             item = _END
         except BaseException as e:   # noqa: BLE001 — relayed, not dropped
             item = _Failure(e)
@@ -83,12 +122,26 @@ def prefetch_to_mesh(iterator: Iterator, mesh: Mesh, size: int = 2
                               daemon=True)
     thread.start()
     try:
+        first = True
         while True:
+            t0 = time.perf_counter() if meter is not None else 0.0
             item = q.get()
             if item is _END:
                 return
             if isinstance(item, _Failure):
                 raise item.exc
+            if meter is not None:
+                # Real batches only (blocking on the end-of-epoch sentinel
+                # is not starvation), and the FIRST batch's wait is
+                # pipeline fill (producer startup + producing batch 1) —
+                # recorded separately so a healthy pipeline never reports
+                # a starved step every epoch.
+                dt = time.perf_counter() - t0
+                if first:
+                    meter.record_first_fill(dt)
+                else:
+                    meter.record_wait(dt)
+            first = False
             # This batch is now "the one being consumed": free its slot so
             # the producer can stage the next one.
             slots.release()

@@ -1,7 +1,9 @@
 from byol_tpu.observability.grapher import Grapher, make_grid
-from byol_tpu.observability.meters import (MetricAccumulator, StepTimer,
-                                           epoch_log_line)
+from byol_tpu.observability.meters import (InputPipelineMeter,
+                                           MetricAccumulator, StepTimer,
+                                           epoch_log_line, input_log_line)
 from byol_tpu.observability import flops, profiling
 
-__all__ = ["Grapher", "make_grid", "MetricAccumulator", "StepTimer",
-           "epoch_log_line", "flops", "profiling"]
+__all__ = ["Grapher", "make_grid", "InputPipelineMeter", "MetricAccumulator",
+           "StepTimer", "epoch_log_line", "input_log_line", "flops",
+           "profiling"]

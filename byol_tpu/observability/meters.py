@@ -76,6 +76,84 @@ def epoch_log_line(prefix: str, epoch: int, num_samples: int,
             f"top1: {get('top1_mean'):.4f}\ttop5: {get('top5_mean'):.4f}")
 
 
+class InputPipelineMeter:
+    """Host input-pipeline health over one epoch (ISSUE 3 meters).
+
+    Fed by ``prefetch_to_mesh``: the PRODUCER records how many host bytes
+    each batch ships to the devices (the H2D payload) and the queue depth
+    it leaves behind; the CONSUMER records how long it blocked waiting for
+    the next device-resident batch (time-to-next-batch).  A wait above
+    ``starvation_threshold_s`` counts as a STARVED step — the chip sat
+    idle because the host pipeline could not keep up.
+
+    Thread-safety: the producer thread writes byte/depth fields, the
+    consumer thread writes wait fields; no field is written by both, and
+    reads happen at the epoch boundary after iteration ends.
+    """
+
+    def __init__(self, starvation_threshold_s: float = 0.005) -> None:
+        self.starvation_threshold_s = starvation_threshold_s
+        self.h2d_bytes = 0           # host bytes shipped (producer)
+        self.batches_produced = 0
+        self._depth_sum = 0          # queue depth samples (producer)
+        self.wait_seconds = 0.0      # consumer block time, total
+        self.starved_seconds = 0.0   # consumer block time above threshold
+        self.starved_steps = 0
+        self.batches_consumed = 0
+        self.first_fill_seconds = 0.0  # time-to-first-batch (pipeline
+                                       # fill) — NOT starvation
+
+    # ---- producer side ----------------------------------------------------
+    def record_produced(self, nbytes: int, queue_depth: int) -> None:
+        self.h2d_bytes += int(nbytes)
+        self._depth_sum += int(queue_depth)
+        self.batches_produced += 1
+
+    # ---- consumer side ----------------------------------------------------
+    def record_first_fill(self, seconds: float) -> None:
+        """The epoch's first wait = producer startup + producing batch 1.
+        Every pipeline pays it once; counting it as starvation would make
+        a healthy run report a starved step per epoch."""
+        self.first_fill_seconds += seconds
+        self.batches_consumed += 1
+
+    def record_wait(self, seconds: float) -> None:
+        self.wait_seconds += seconds
+        if seconds > self.starvation_threshold_s:
+            self.starved_seconds += seconds
+            self.starved_steps += 1
+        self.batches_consumed += 1
+
+    # ---- epoch-boundary readout -------------------------------------------
+    def h2d_bytes_per_step(self) -> float:
+        return (self.h2d_bytes / self.batches_produced
+                if self.batches_produced else 0.0)
+
+    def avg_queue_depth(self) -> float:
+        return (self._depth_sum / self.batches_produced
+                if self.batches_produced else 0.0)
+
+    def result(self) -> Dict[str, float]:
+        """Scalar dict for the grapher / epoch log."""
+        return {"h2d_bytes_per_step": self.h2d_bytes_per_step(),
+                "input_starved_seconds": self.starved_seconds,
+                "input_starved_steps": float(self.starved_steps),
+                "input_wait_seconds": self.wait_seconds,
+                "input_first_fill_seconds": self.first_fill_seconds,
+                "prefetch_queue_depth": self.avg_queue_depth()}
+
+
+def input_log_line(epoch: int, meter: InputPipelineMeter) -> str:
+    """One-line input-pipeline summary next to the train epoch line."""
+    return (f"input[Epoch {epoch}]"
+            f"[{meter.batches_consumed} batches]: "
+            f"h2d: {meter.h2d_bytes_per_step() / 2 ** 20:.2f} MiB/step\t"
+            f"starved: {meter.starved_seconds:.2f} sec "
+            f"({meter.starved_steps} steps)\t"
+            f"fill: {meter.first_fill_seconds:.2f} sec\t"
+            f"queue depth: {meter.avg_queue_depth():.2f}")
+
+
 class StepTimer:
     """images/sec/chip measured ONLY over host-synchronized intervals.
 

@@ -125,7 +125,11 @@ def step_config(rcfg: ResolvedConfig) -> StepConfig:
         ema_update_mode=cfg.parity.ema_update_mode,
         accum_steps=cfg.optim.accum_steps,
         accum_bn_mode=cfg.optim.accum_bn_mode,
-        normalize_inputs=cfg.parity.normalize_inputs)
+        normalize_inputs=cfg.parity.normalize_inputs,
+        augment_in_step=cfg.task.augment_placement == "step",
+        image_size=rcfg.input_shape[0],
+        color_jitter_strength=cfg.regularizer.color_jitter_strength,
+        aug_seed=cfg.device.seed)
 
 
 def _validate_remat_tags(net, rcfg: ResolvedConfig, variables,

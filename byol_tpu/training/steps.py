@@ -50,6 +50,17 @@ The microbatch partition is STRIDED (microbatch i takes rows i, i+k, ...),
 which keeps the reshape device-local under the GSPMD batch sharding — no
 resharding collectives.  Batch order is i.i.d. so the partition choice is
 semantically free.
+
+Step-fused augmentation (``augment_in_step``, the ``--augment-placement
+step`` mode): the batch is ``{'images': (B,H,W,C) uint8, 'label': (B,)}``
+— raw pixels, ~8x fewer H2D bytes than two float32 views — and the two-view
+augmentation (data/device_augment.py, the SAME program the loader-placement
+device backend dispatches) runs per microbatch INSIDE the accumulation
+scan: only one microbatch of float32 views is ever live in HBM, and the
+augment fuses with the forward instead of costing a separate dispatch.
+Per-microbatch PRNG keys derive from ``state.step`` (:func:`augment_keys`),
+so every optimizer step sees fresh, reproducible randomness with no key
+reuse across microbatches.
 """
 from __future__ import annotations
 
@@ -61,7 +72,9 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from byol_tpu.core import rng as rng_lib
 from byol_tpu.core.precision import Policy, FP32
+from byol_tpu.data import device_augment
 from byol_tpu.objectives.byol_loss import loss_function
 from byol_tpu.objectives.metrics import cross_entropy, topk_accuracy
 from byol_tpu.optim.schedules import cosine_ema_decay
@@ -105,6 +118,13 @@ class StepConfig:
     accum_bn_mode: str = "average"       # 'average'|'microbatch'|'global'
     normalize_inputs: bool = False       # Quirk Q3: ImageNet mean/std
                                          # standardization inside the step
+    augment_in_step: bool = False        # --augment-placement step: batch is
+                                         # raw uint8; two-view augmentation
+                                         # runs inside the accumulation scan
+    image_size: int = 0                  # augment target size (= model input
+                                         # H); required when augment_in_step
+    color_jitter_strength: float = 1.0   # augment strength (step placement)
+    aug_seed: int = 0                    # base seed of the in-step key stream
 
 
 def _forward_views(net, params, batch_stats, aug1, aug2, *, train: bool,
@@ -156,6 +176,20 @@ def _microbatch_split(x: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.swapaxes(x, 0, 1)
 
 
+def augment_keys(seed: int, step, k: int) -> jnp.ndarray:
+    """(k, ...) per-microbatch augmentation keys for optimizer step ``step``.
+
+    Fresh per step (fold_in on the traced counter), decorrelated across
+    microbatches (fold_in on the microbatch index).  Module-level on purpose:
+    tests and tools reproduce the in-step view stream exactly by feeding
+    these keys to ``device_augment.two_view_batch`` on the strided
+    microbatch partition (:func:`_microbatch_split`).
+    """
+    step_key = rng_lib.for_step(rng_lib.root_key(seed), step)
+    return jax.vmap(lambda i: rng_lib.for_step(step_key, i))(
+        jnp.arange(k, dtype=jnp.uint32))
+
+
 def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
                     policy: Policy = FP32
                     ) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
@@ -173,6 +207,10 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
         raise ValueError(
             f"unknown accum_bn_mode {scfg.accum_bn_mode!r}; "
             "'average' | 'microbatch' | 'global'")
+    if scfg.augment_in_step and scfg.image_size <= 0:
+        raise ValueError(
+            "augment_in_step requires image_size > 0 (the augment target "
+            f"size), got {scfg.image_size}")
 
     def micro_grads(params, target_params, batch_stats, view1, view2,
                     labels):
@@ -221,29 +259,45 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
             loss_fn, has_aux=True)(params)
         return policy.cast_to_param(grads), new_bs, metrics
 
-    def accumulate_scan(state: TrainState, views1, views2, labels):
+    def micro_views(xs):
+        """One microbatch's (view1, view2, labels) from the scan/vmap
+        element: materialized views under loader placement, or raw uint8
+        pixels augmented HERE — inside the accumulation scan, so only this
+        microbatch's float32 views are ever live — under step placement."""
+        if scfg.augment_in_step:
+            v1, v2 = device_augment.two_view(
+                xs["key"], xs["images"], scfg.image_size,
+                strength=scfg.color_jitter_strength)
+            return v1, v2, xs["label"]
+        return xs["view1"], xs["view2"], xs["label"]
+
+    def micro_step(state: TrainState, bs_in, xs):
+        v1, v2, lbl = micro_views(xs)
+        return micro_grads(state.params, state.target_params, bs_in,
+                           v1, v2, lbl)
+
+    def accumulate_scan(state: TrainState, xs):
         """'average' / 'microbatch' modes: lax.scan over microbatches with
         jax.grad INSIDE the body, so only one microbatch's backward
-        residuals are live at a time (the HBM win)."""
+        residuals are live at a time (the HBM win).  ``xs`` is the stacked
+        (leading dim k) per-microbatch input pytree (micro_views)."""
         k = scfg.accum_steps
         sequential_bn = scfg.accum_bn_mode == "microbatch"
         # Abstract eval gives the carry structure without running anything.
+        xs0 = jax.tree_util.tree_map(lambda a: a[0], xs)
         g_shape, bs_shape, m_shape = jax.eval_shape(
-            micro_grads, state.params, state.target_params,
-            state.batch_stats, views1[0], views2[0], labels[0])
+            micro_step, state, state.batch_stats, xs0)
         zeros = lambda shapes: jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
-        def body(carry, xs):
+        def body(carry, x):
             grad_sum, bs_acc, metric_sum = carry
-            v1, v2, lbl = xs
             # 'microbatch': thread running stats through the scan (k ticks);
             # 'average': every microbatch ticks from the step's input stats,
             # and the tick results are averaged afterwards (one effective
             # tick with microbatch-averaged batch statistics).
             bs_in = bs_acc if sequential_bn else state.batch_stats
-            g, new_bs, m = micro_grads(state.params, state.target_params,
-                                       bs_in, v1, v2, lbl)
+            g, new_bs, m = micro_step(state, bs_in, x)
             add = lambda a, b: jax.tree_util.tree_map(jnp.add, a, b)
             grad_sum = add(grad_sum, g)
             bs_acc = new_bs if sequential_bn else add(bs_acc, new_bs)
@@ -253,8 +307,7 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
         init = (zeros(g_shape),
                 state.batch_stats if sequential_bn else zeros(bs_shape),
                 zeros(m_shape))
-        (grad_sum, bs_acc, metric_sum), _ = jax.lax.scan(
-            body, init, (views1, views2, labels))
+        (grad_sum, bs_acc, metric_sum), _ = jax.lax.scan(body, init, xs)
         mean = lambda t: jax.tree_util.tree_map(
             lambda x: (x / k).astype(x.dtype), t)
         # Equal-size microbatches: the mean over microbatch means IS the
@@ -262,17 +315,15 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
         new_bs = bs_acc if sequential_bn else mean(bs_acc)
         return mean(grad_sum), new_bs, mean(metric_sum)
 
-    def accumulate_global(state: TrainState, views1, views2, labels):
+    def accumulate_global(state: TrainState, xs):
         """'global' mode: vmap over microbatches with ACCUM_AXIS bound, so
         every BatchNorm pmeans its statistics across the whole effective
         batch and AD through the psum recovers the exact big-batch gradient
         (mean over instances).  All microbatches are in flight — exact
         semantics, no memory savings."""
         grads_k, bs_k, metrics_k = jax.vmap(
-            micro_grads, in_axes=(None, None, None, 0, 0, 0),
-            axis_name=ACCUM_AXIS)(
-                state.params, state.target_params, state.batch_stats,
-                views1, views2, labels)
+            lambda x: micro_step(state, state.batch_stats, x),
+            axis_name=ACCUM_AXIS)(xs)
         mean0 = lambda t: jax.tree_util.tree_map(
             lambda x: jnp.mean(x, axis=0).astype(x.dtype), t)
         # Statistics are synced across the axis, so every instance computed
@@ -282,19 +333,27 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
 
     def train_step(state: TrainState, batch):
         labels = batch["label"]
-        if scfg.accum_steps == 1:
-            grads, new_bs, metrics = micro_grads(
-                state.params, state.target_params, state.batch_stats,
-                batch["view1"], batch["view2"], labels)
+        k = scfg.accum_steps
+        if scfg.augment_in_step:
+            keys = augment_keys(scfg.aug_seed, state.step, k)
+            parts = {"images": batch["images"], "label": labels}
         else:
-            views1 = _microbatch_split(batch["view1"], scfg.accum_steps)
-            views2 = _microbatch_split(batch["view2"], scfg.accum_steps)
-            mlabels = _microbatch_split(labels, scfg.accum_steps)
+            parts = {"view1": batch["view1"], "view2": batch["view2"],
+                     "label": labels}
+        if k == 1:
+            if scfg.augment_in_step:
+                parts["key"] = keys[0]
+            grads, new_bs, metrics = micro_step(state, state.batch_stats,
+                                                parts)
+        else:
+            xs = {name: _microbatch_split(v, k)
+                  for name, v in parts.items()}
+            if scfg.augment_in_step:
+                xs["key"] = keys
             accumulate = (accumulate_global
                           if scfg.accum_bn_mode == "global"
                           else accumulate_scan)
-            grads, new_bs, metrics = accumulate(state, views1, views2,
-                                                mlabels)
+            grads, new_bs, metrics = accumulate(state, xs)
 
         updates, new_opt_state = tx.update(grads, state.opt_state,
                                            state.params)

@@ -36,8 +36,9 @@ from byol_tpu.checkpoint import ModelSaver
 from byol_tpu.core.config import Config, ResolvedConfig, resolve, run_name
 from byol_tpu.data.loader import LoaderBundle, get_loader, pad_batch
 from byol_tpu.data.prefetch import prefetch_to_mesh
-from byol_tpu.observability import (Grapher, MetricAccumulator, StepTimer,
-                                    epoch_log_line)
+from byol_tpu.observability import (Grapher, InputPipelineMeter,
+                                    MetricAccumulator, StepTimer,
+                                    epoch_log_line, input_log_line)
 from byol_tpu.parallel.mesh import (MeshSpec, build_mesh, initialize_distributed,
                                     shard_batch_to_mesh)
 from byol_tpu.training.build import setup_training
@@ -60,7 +61,17 @@ class FitResult:
 
 def _range_check(batch: Dict[str, np.ndarray]) -> None:
     """The reference's startup input contract: augmented pixels must stay in
-    [0,1] (main.py:486-490) — hard failure, not a warning."""
+    [0,1] (main.py:486-490) — hard failure, not a warning.  Step-placement
+    batches ship RAW pixels instead of views; their contract is dtype
+    uint8 (the step divides by 255 on device)."""
+    if "images" in batch:
+        v = np.asarray(batch["images"])
+        if v.dtype != np.uint8:
+            raise ValueError(
+                f"augment_placement='step' raw batch must be uint8, got "
+                f"{v.dtype} (the H2D-bandwidth contract, data/loader.py "
+                f"_raw_pipeline)")
+        return
     for key in ("view1", "view2"):
         v = np.asarray(batch[key])
         lo, hi = float(v.min()), float(v.max())
@@ -285,13 +296,19 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                 if not first_batch_checked:
                     _range_check(batch)
                     first_batch_checked = True
-                if sample_batch is None:
+                if sample_batch is None and "view1" in batch:
+                    # step placement ships raw pixels — no host-side views
+                    # to grid; the eval path still plots resized images
                     sample_batch = {k: np.asarray(batch[k][:64])
                                     for k in ("view1", "view2")}
                 yield batch
 
-        # double-buffered H2D: batch N+1 transfers while step N computes
-        for dev_batch in prefetch_to_mesh(tapped_batches(), mesh):
+        # double-buffered H2D: batch N+1 transfers while step N computes;
+        # the meter reports this epoch's H2D payload + starvation next to
+        # the throughput numbers
+        input_meter = InputPipelineMeter()
+        for dev_batch in prefetch_to_mesh(tapped_batches(), mesh,
+                                          meter=input_meter):
             if not flops_resolved:
                 # Once per fit: FLOPs of the real train step via XLA cost
                 # analysis (observability/flops.py) -> MFU next to every
@@ -332,6 +349,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
             print(epoch_log_line("train", epoch,
                                  acc.count * rcfg.global_batch_size,
                                  train_elapsed, train_metrics))
+            print(input_log_line(epoch, input_meter))
 
         # ---- eval (prefix='test', main.py:680-692) -----------------------
         t0 = time.time()
@@ -370,6 +388,8 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                            epoch)
         grapher.add_scalar("images_per_sec_per_chip",
                            timer.images_per_sec_per_chip(), epoch)
+        for key, value in input_meter.result().items():
+            grapher.add_scalar(f"{key}_scalar", value, epoch)
         epoch_mfu = timer.mfu()
         if epoch_mfu is not None:
             grapher.add_scalar("mfu_scalar", epoch_mfu, epoch)

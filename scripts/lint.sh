@@ -6,10 +6,15 @@
 #   - machine JSON at evidence/graphlint.json (schema in
 #     tools/graphlint/reporters.py), committed so rule-count trends are
 #     diffable across PRs.
+# It also enforces the suppression-trend ratchet (--trend-baseline): the
+# run FAILS when any rule's suppression count grew vs the committed
+# evidence file, and on an alarm the evidence file is left untouched so
+# the grown count can never silently become the new baseline.
 #
 # Extra args (e.g. `scripts/lint.sh --select GL103`) pass through but
-# SKIP the evidence write — a partial-rule sweep must never overwrite
-# the committed full-sweep trend file.
+# SKIP the evidence write and the trend ratchet — a partial-rule sweep
+# must never overwrite (or ratchet against) the committed full-sweep
+# trend file.
 #
 # Exit: 0 clean, 1 findings, 2 usage error — same contract as
 # `python -m tools.graphlint`.  Tier-1 shells the same entrypoint
@@ -24,6 +29,8 @@ export JAX_PLATFORMS=cpu
 
 if [ "$#" -eq 0 ]; then
     mkdir -p evidence
-    exec python -m tools.graphlint byol_tpu/ --out evidence/graphlint.json
+    exec python -m tools.graphlint byol_tpu/ \
+        --trend-baseline evidence/graphlint.json \
+        --out evidence/graphlint.json
 fi
 exec python -m tools.graphlint byol_tpu/ "$@"

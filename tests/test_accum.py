@@ -32,9 +32,10 @@ BATCH = 32
 
 def tiny_config(**optim_overrides):
     model_overrides = optim_overrides.pop("model", {})
+    batch = optim_overrides.pop("batch_size", BATCH)
     c = config_lib.Config()
     c = c.replace(
-        task=dataclasses.replace(c.task, batch_size=BATCH, epochs=2),
+        task=dataclasses.replace(c.task, batch_size=batch, epochs=2),
         model=dataclasses.replace(c.model, arch="resnet18",
                                   head_latent_size=64, projection_size=32,
                                   **model_overrides),
@@ -144,6 +145,64 @@ class TestAccumulationParity:
             tiny_config(accum_steps=5)      # 32 % (5*8) != 0
         with pytest.raises(ValueError, match="accum_bn_mode"):
             tiny_config(accum_steps=4, accum_bn_mode="bogus")
+
+
+class TestAccumBNModeDelta:
+    """ROADMAP open item, quantified: ``accum_bn_mode='average'`` ticks the
+    BN running stats with the microbatch-averaged batch statistics — its
+    running VARIANCE is a mean of microbatch variances, not the global
+    variance ``'global'`` computes.  Eval-time BN reads these stats, so the
+    delta must be measured before recommending 'average' for paper-recipe
+    runs.  Measured here at accum 16 (the paper-scale 4096/256 ratio) and
+    recorded in RESULTS.md."""
+
+    def _run(self, mesh, bn_mode, batches, eval_batch):
+        rcfg = tiny_config(accum_steps=16, accum_bn_mode=bn_mode,
+                           batch_size=128)
+        net, state, train_step, eval_step, _ = setup_training(
+            rcfg, mesh, jax.random.PRNGKey(0))
+        train_step = guard_steps(train_step)
+        for b in batches:
+            state, _ = train_step(state, shard_batch_to_mesh(b, mesh))
+        em = guard_steps(eval_step)(state,
+                                    shard_batch_to_mesh(eval_batch, mesh))
+        return state, {k: float(v) for k, v in em.items()}
+
+    @pytest.mark.slow    # two accum-16 compiles (~100 s cold); the numbers
+    # it pins are recorded in RESULTS.md — tier-1 already covers the
+    # accumulation plumbing via TestAccumulationParity
+    def test_average_vs_global_eval_delta_accum16(self, mesh8):
+        rng = np.random.RandomState(0)
+        mk = lambda: {"view1": rng.rand(128, 32, 32, 3).astype(np.float32),
+                      "view2": rng.rand(128, 32, 32, 3).astype(np.float32),
+                      "label": rng.randint(0, 10, 128).astype(np.int32)}
+        batches, eval_batch = [mk(), mk()], mk()
+        st_avg, ev_avg = self._run(mesh8, "average", batches, eval_batch)
+        st_glo, ev_glo = self._run(mesh8, "global", batches, eval_batch)
+
+        # running-variance divergence: relative, per leaf ending in 'var'
+        from jax import tree_util as tu
+        fa = {tu.keystr(k): np.asarray(v)
+              for k, v in tu.tree_leaves_with_path(st_avg.batch_stats)}
+        fg = {tu.keystr(k): np.asarray(v)
+              for k, v in tu.tree_leaves_with_path(st_glo.batch_stats)}
+        rel = np.concatenate([
+            (np.abs(fa[k] - fg[k]) / (np.abs(fg[k]) + 1e-6)).ravel()
+            for k in fa if "var" in k])
+        # The modes genuinely differ (mean-of-variances != global variance)
+        # but only at the sub-percent level at accum 16 after 2 ticks:
+        # measured mean 7.3e-4, max 1.4e-2 (RESULTS.md "accum_bn_mode
+        # eval delta").  Bounds leave ~3x headroom over the measurement.
+        assert rel.mean() > 0.0
+        assert rel.mean() < 2.5e-3, rel.mean()
+        assert rel.max() < 5e-2, rel.max()
+
+        # eval-time metric deltas through those stats: measured loss_mean
+        # delta 1.4e-2 (byol-dominated), linear CE 2.5e-4, top1/top5 equal.
+        assert abs(ev_avg["loss_mean"] - ev_glo["loss_mean"]) < 5e-2
+        assert abs(ev_avg["linear_loss_mean"]
+                   - ev_glo["linear_loss_mean"]) < 5e-3
+        assert ev_avg["top1_mean"] == ev_glo["top1_mean"]
 
 
 class TestMicrobatchSplit:

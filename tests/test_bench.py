@@ -506,3 +506,52 @@ class TestArchOverride:
         monkeypatch.setattr(_sys, "argv", ["bench.py", "--arch", "vit_b_16"])
         with pytest.raises(SystemExit, match="unknown arch"):
             bench.main()
+
+
+class TestInputLadderPlumbing:
+    """ISSUE 3 bench surface: every row records h2d_bytes_per_step, and the
+    --input-ladder / --dry-compile plumbing carries --augment-placement."""
+
+    def test_batch_h2d_bytes_concrete_and_abstract(self, bench):
+        import numpy as np
+        import jax as _jax
+        concrete = {"view1": np.zeros((2, 4, 4, 3), np.float32),
+                    "view2": np.zeros((2, 4, 4, 3), np.float32),
+                    "label": np.zeros((2,), np.int32)}
+        want = 2 * (2 * 4 * 4 * 3 * 4) + 2 * 4
+        assert bench._batch_h2d_bytes(concrete) == want
+        abstract = {"images": _jax.ShapeDtypeStruct((2, 4, 4, 3), np.uint8),
+                    "label": _jax.ShapeDtypeStruct((2,), np.int32)}
+        assert bench._batch_h2d_bytes(abstract) == 2 * 4 * 4 * 3 + 2 * 4
+
+    def test_abstract_batch_placements(self, bench, mesh8):
+        import numpy as np
+        raw = bench._abstract_batch(8, 16, mesh8, augment_placement="step")
+        assert sorted(raw) == ["images", "label"]
+        assert raw["images"].dtype == np.uint8
+        views = bench._abstract_batch(8, 16, mesh8)
+        assert sorted(views) == ["label", "view1", "view2"]
+        assert views["view1"].dtype == np.float32
+        # the 8x H2D contract, end to end through the helper pair
+        assert (bench._batch_h2d_bytes(views) - 8 * 4
+                == 8 * (bench._batch_h2d_bytes(raw) - 8 * 4))
+
+    def test_gate_args_forward_placement_and_arch(self, bench):
+        args = bench._gate_args(512, 256, "dots", "average", "dense",
+                                "vit_b16", placement="step")
+        assert "--augment-placement" in args
+        assert args[args.index("--augment-placement") + 1] == "step"
+        assert args[args.index("--arch") + 1] == "vit_b16"
+
+    def test_input_gate_phase_names_both_placements(self, bench,
+                                                    monkeypatch):
+        ran = []
+
+        def fake_gates(rungs, timeout):
+            ran.extend(name for name, _ in rungs)
+            return {name: {"status": "ok", "row": {}} for name, _ in rungs}
+        monkeypatch.setattr(bench, "_run_compile_gates", fake_gates)
+        gates = bench._input_gate_phase(False, None, "dense")
+        # CPU fallback ladder: one effective rung, both placements
+        assert ran == ["input_eff32_mb16_loader", "input_eff32_mb16_step"]
+        assert set(gates) == set(ran)

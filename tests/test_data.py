@@ -225,26 +225,34 @@ class TestImageFolder:
 
 
 class TestDeviceAugment:
-    def test_two_view_batch(self):
+    """Equivalence/contract tests for the on-device augmentation — run
+    under the ``guard_steps`` transfer guard (conftest.py), so a hidden
+    host sync or tracer leak inside the jitted augmentation fails here on
+    CPU exactly like the train/eval steps' guard does.  Inputs are
+    device_put EXPLICITLY: only implicit transfers are the bug."""
+
+    def test_two_view_batch(self, step_guard):
         import jax
         from byol_tpu.data.device_augment import two_view_batch
+        guarded = step_guard(two_view_batch)
         rng = np.random.RandomState(0)
-        imgs = rng.randint(0, 255, (4, 40, 40, 3), dtype=np.uint8)
-        v1, v2 = two_view_batch(jax.random.PRNGKey(0), imgs, 32)
+        imgs = jax.device_put(
+            rng.randint(0, 255, (4, 40, 40, 3), dtype=np.uint8))
+        v1, v2 = guarded(jax.random.PRNGKey(0), imgs, 32)
         assert v1.shape == v2.shape == (4, 32, 32, 3)
         assert float(v1.min()) >= 0.0 and float(v1.max()) <= 1.0
         assert not np.allclose(np.asarray(v1), np.asarray(v2))
         # deterministic under the same key
-        w1, _ = two_view_batch(jax.random.PRNGKey(0), imgs, 32)
+        w1, _ = guarded(jax.random.PRNGKey(0), imgs, 32)
         np.testing.assert_allclose(np.asarray(v1), np.asarray(w1))
 
-    def test_per_image_independence(self):
+    def test_per_image_independence(self, step_guard):
         import jax
         from byol_tpu.data.device_augment import two_view_batch
-        imgs = np.tile(
+        imgs = jax.device_put(np.tile(
             np.linspace(0, 1, 40 * 40 * 3, dtype=np.float32
-                        ).reshape(1, 40, 40, 3), (3, 1, 1, 1))
-        v1, _ = two_view_batch(jax.random.PRNGKey(1), imgs, 32)
+                        ).reshape(1, 40, 40, 3), (3, 1, 1, 1)))
+        v1, _ = step_guard(two_view_batch)(jax.random.PRNGKey(1), imgs, 32)
         assert not np.allclose(np.asarray(v1[0]), np.asarray(v1[1]))
 
     def test_device_backend_wired_into_loader(self):
@@ -490,12 +498,15 @@ class TestGaussianBlurOracle:
         out = gaussian_blur(tf.constant(img), 5, seed=(3, 4)).numpy()
         np.testing.assert_allclose(out, img, rtol=1e-5, atol=1e-6)
 
-    def test_device_blur_preserves_constant_image_at_borders(self):
+    def test_device_blur_preserves_constant_image_at_borders(self,
+                                                             step_guard):
         """Same border contract for the on-device (JAX) blur backend."""
         import jax
         import jax.numpy as jnp
         from byol_tpu.data import device_augment
         img = jnp.full((10, 10, 3), 0.7, jnp.float32)
-        out = device_augment.gaussian_blur(jax.random.PRNGKey(0), img, 5)
+        blur = step_guard(jax.jit(device_augment.gaussian_blur,
+                                  static_argnums=(2,)))
+        out = blur(jax.random.PRNGKey(0), img, 5)
         np.testing.assert_allclose(np.asarray(out), np.asarray(img),
                                    rtol=1e-5, atol=1e-6)

@@ -175,7 +175,8 @@ class TestEngineSemantics:
         assert payload["clean"] is True
         assert payload["files_scanned"] == 1
         assert payload["findings"] == []
-        assert payload["schema_version"] == 1
+        assert payload["schema_version"] == 2
+        assert payload["suppressions_by_rule"] == {}
 
     def test_out_json_with_text_stdout(self, tmp_path):
         """One run, both reports: text on stdout, JSON at --out *.json —
@@ -191,6 +192,79 @@ class TestEngineSemantics:
         assert "finding(s) in 1 file(s) scanned" in proc.stdout  # text
         payload = json.loads(out.read_text())                    # json
         assert payload["clean"] is True
+
+
+class TestTrendAlarm:
+    """ROADMAP rule-wave-2 (d): the suppression-trend ratchet.  A rule's
+    suppression count growing vs the committed evidence baseline fails the
+    run even when every finding is suppressed (= lint-clean)."""
+
+    SUPPRESSED = ("import jax\n\n\ndef f(key):\n"
+                  "    a = jax.random.uniform(key)\n"
+                  "    # graphlint: disable=GL103 -- fixture: deliberate\n"
+                  "    b = jax.random.uniform(key)\n"
+                  "    return a + b\n")
+
+    def _baseline(self, tmp_path, suppressions):
+        base = tmp_path / "baseline.json"
+        base.write_text(json.dumps({"schema_version": 2,
+                                    "suppressions_by_rule": suppressions}))
+        return base
+
+    def _run(self, tmp_path, baseline, out=None):
+        cmd = [sys.executable, "-m", "tools.graphlint",
+               str(tmp_path / "code.py"), "--trend-baseline", str(baseline)]
+        if out is not None:
+            cmd += ["--out", str(out)]
+        return subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+
+    def test_grown_suppression_count_fails(self, tmp_path):
+        (tmp_path / "code.py").write_text(self.SUPPRESSED)
+        base = self._baseline(tmp_path, {"GL103": 0})
+        out = tmp_path / "report.json"
+        proc = self._run(tmp_path, base, out)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "trend alarm" in proc.stderr and "GL103: 0 -> 1" in proc.stderr
+        # an alarmed run must not rewrite the evidence (the ratchet would
+        # vanish on the next run)
+        assert not out.exists()
+
+    def test_stable_count_passes_and_writes_evidence(self, tmp_path):
+        (tmp_path / "code.py").write_text(self.SUPPRESSED)
+        base = self._baseline(tmp_path, {"GL103": 1})
+        out = tmp_path / "report.json"
+        proc = self._run(tmp_path, base, out)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(out.read_text())
+        assert payload["suppressions_by_rule"] == {"GL103": 1}
+
+    def test_shrunk_count_passes(self, tmp_path):
+        (tmp_path / "code.py").write_text("x = 1\n")
+        base = self._baseline(tmp_path, {"GL103": 3})
+        proc = self._run(tmp_path, base)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_missing_baseline_skips_with_note(self, tmp_path):
+        (tmp_path / "code.py").write_text(self.SUPPRESSED)
+        out = tmp_path / "report.json"
+        proc = self._run(tmp_path, tmp_path / "nope.json", out)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "skipping the suppression-trend check" in proc.stderr
+        assert out.exists()   # first run seeds the baseline
+
+    def test_corrupt_baseline_is_usage_error(self, tmp_path):
+        (tmp_path / "code.py").write_text("x = 1\n")
+        bad = tmp_path / "baseline.json"
+        bad.write_text("{not json")
+        proc = self._run(tmp_path, bad)
+        assert proc.returncode == 2
+
+    def test_lint_sh_default_run_ratchets(self):
+        """The shipped wiring: scripts/lint.sh passes the committed
+        evidence file as the baseline (inspect, don't execute — the real
+        run rewrites the committed evidence)."""
+        text = (REPO / "scripts" / "lint.sh").read_text()
+        assert "--trend-baseline evidence/graphlint.json" in text
 
 
 class TestTreeGate:

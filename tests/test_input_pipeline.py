@@ -1,0 +1,321 @@
+"""Step-fused augmentation + uint8 streaming input pipeline (ISSUE 3).
+
+The contracts under test:
+- ``augment_placement='step'`` ships RAW uint8 batches and the jitted train
+  step augments per microbatch INSIDE the accumulation scan; with identical
+  PRNG keys the fused path produces the SAME views as the loader-path
+  ``two_view_batch`` (they trace the one ``device_augment.two_view``
+  program), and a full train-step parity run reaches matching loss and
+  post-step params on the same synthetic stream;
+- the raw loader pipeline keeps the epoch-reseed/drop-remainder contract
+  and rejects unservable combinations (image_folder, paper aug spec, the
+  loader-dispatched device backend) at build time;
+- the input-pipeline meters (time-to-next-batch / starvation, H2D bytes
+  per step, prefetch queue depth) account correctly through
+  ``prefetch_to_mesh``.
+
+Augment/step calls run under ``guard_steps`` (conftest.py): a hidden host
+sync or tracer leak inside the fused augmentation fails here, on CPU, in
+tier-1 — not on a TPU window.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byol_tpu.core import config as config_lib
+from byol_tpu.core.config import (Config, DeviceConfig, RegularizerConfig,
+                                  TaskConfig)
+from byol_tpu.data import get_loader
+from byol_tpu.parallel.mesh import shard_batch_to_mesh
+from byol_tpu.training.build import setup_training
+from byol_tpu.training.steps import augment_keys
+from tests.conftest import guard_steps
+
+SIZE = 24      # augment target (= model input)
+RAW = 28       # stored raw image size (crops come from here)
+
+
+def make_rcfg(placement, accum_steps=1, batch=16):
+    c = config_lib.Config()
+    c = c.replace(
+        task=dataclasses.replace(c.task, batch_size=batch, epochs=2,
+                                 augment_placement=placement,
+                                 image_size_override=SIZE),
+        model=dataclasses.replace(c.model, arch="resnet18",
+                                  head_latent_size=64, projection_size=32),
+        optim=dataclasses.replace(c.optim, warmup=1, lr=0.1,
+                                  accum_steps=accum_steps),
+        device=dataclasses.replace(c.device, num_replicas=8, half=False,
+                                   seed=11),
+    )
+    return config_lib.resolve(c, num_train_samples=128, num_test_samples=32,
+                              output_size=10, input_shape=(SIZE, SIZE, 3))
+
+
+def tree_maxdiff(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return max(float(np.max(np.abs(np.asarray(x, np.float32)
+                                   - np.asarray(y, np.float32))))
+               for x, y in zip(la, lb))
+
+
+class TestViewEquivalence:
+    def test_step_program_equals_loader_dispatch(self, step_guard):
+        """ACCEPTANCE: identical keys -> identical views.  The step-fused
+        path traces ``device_augment.two_view``; the loader device backend
+        jit-dispatches ``two_view_batch``; both must agree exactly.  Run
+        under the transfer guard: no hidden host syncs in either path."""
+        from byol_tpu.data import device_augment
+        rng = np.random.RandomState(0)
+        imgs = jax.device_put(
+            rng.randint(0, 256, (4, RAW, RAW, 3), dtype=np.uint8))
+        key = jax.random.PRNGKey(5)
+        fused = jax.jit(lambda k, im: device_augment.two_view(k, im, SIZE))
+        v1a, v2a = step_guard(fused)(key, imgs)
+        v1b, v2b = step_guard(device_augment.two_view_batch)(key, imgs, SIZE)
+        np.testing.assert_array_equal(np.asarray(v1a), np.asarray(v1b))
+        np.testing.assert_array_equal(np.asarray(v2a), np.asarray(v2b))
+
+    def test_augment_keys_fresh_per_step_and_microbatch(self):
+        """No key reuse (the GL103 contract, runtime edition): every
+        (step, microbatch) pair draws a distinct key, reproducibly."""
+        k0 = np.asarray(augment_keys(7, jnp.asarray(0, jnp.int32), 4))
+        k0b = np.asarray(augment_keys(7, jnp.asarray(0, jnp.int32), 4))
+        k1 = np.asarray(augment_keys(7, jnp.asarray(1, jnp.int32), 4))
+        np.testing.assert_array_equal(k0, k0b)        # deterministic
+        flat = {tuple(map(int, k)) for k in np.concatenate([k0, k1])}
+        assert len(flat) == 8                         # all distinct
+
+
+class TestTrainStepParity:
+    @pytest.mark.parametrize("accum", [1, 2])
+    def test_loader_vs_step_same_keys_match(self, mesh8, step_guard, accum):
+        """ACCEPTANCE: the step-fused train step == the loader-placement
+        train step fed the views it would have derived (augment_keys +
+        strided microbatch partition + two_view_batch) — matching loss
+        metrics AND post-step params on the same synthetic stream."""
+        from byol_tpu.data.device_augment import two_view_batch
+        rcfg_s = make_rcfg("step", accum_steps=accum)
+        _, state_s, step_s, _, _ = setup_training(
+            rcfg_s, mesh8, jax.random.PRNGKey(0))
+        rcfg_l = make_rcfg("loader", accum_steps=accum)
+        _, state_l, step_l, _, _ = setup_training(
+            rcfg_l, mesh8, jax.random.PRNGKey(0))
+
+        rng = np.random.RandomState(3)
+        images = rng.randint(0, 256, (16, RAW, RAW, 3), dtype=np.uint8)
+        labels = rng.randint(0, 10, size=(16,)).astype(np.int32)
+
+        # reconstruct the views the fused step derives at state.step == 0
+        keys = np.asarray(augment_keys(rcfg_s.cfg.device.seed,
+                                       jnp.asarray(0, jnp.int32), accum))
+        v1 = np.zeros((16, SIZE, SIZE, 3), np.float32)
+        v2 = np.zeros_like(v1)
+        for i in range(accum):
+            a, b = two_view_batch(jnp.asarray(keys[i]),
+                                  jnp.asarray(images[i::accum]), SIZE)
+            v1[i::accum], v2[i::accum] = np.asarray(a), np.asarray(b)
+
+        sb = shard_batch_to_mesh({"images": images, "label": labels}, mesh8)
+        lb = shard_batch_to_mesh({"view1": v1, "view2": v2,
+                                  "label": labels}, mesh8)
+        state_s, m_s = step_guard(step_s)(state_s, sb)
+        state_l, m_l = step_guard(step_l)(state_l, lb)
+        for k in m_s:
+            np.testing.assert_allclose(float(m_s[k]), float(m_l[k]),
+                                       rtol=2e-4, atol=2e-4, err_msg=k)
+        # identical views -> identical gradients up to fusion-order noise
+        assert tree_maxdiff(state_s.params, state_l.params) < 5e-4
+        assert tree_maxdiff(state_s.batch_stats, state_l.batch_stats) < 1e-4
+
+    def test_step_counter_feeds_fresh_augmentation(self, mesh8, step_guard):
+        """The same raw batch fed twice must NOT produce the same loss:
+        keys derive from state.step, so step 2 re-augments differently
+        (the set_all_epochs/fresh-randomness analog for the fused path)."""
+        rcfg = make_rcfg("step", accum_steps=2)
+        _, state, train_step, _, _ = setup_training(
+            rcfg, mesh8, jax.random.PRNGKey(0))
+        train_step = guard_steps(train_step)
+        rng = np.random.RandomState(0)
+        batch = shard_batch_to_mesh(
+            {"images": rng.randint(0, 256, (16, RAW, RAW, 3),
+                                   dtype=np.uint8),
+             "label": rng.randint(0, 10, size=(16,)).astype(np.int32)},
+            mesh8)
+        state, m1 = train_step(state, batch)
+        state, m2 = train_step(state, batch)
+        assert int(state.step) == 2
+        assert float(m1["byol_loss_mean"]) != float(m2["byol_loss_mean"])
+
+    def test_step_config_requires_image_size(self):
+        from byol_tpu.training.steps import StepConfig, make_train_step
+        with pytest.raises(ValueError, match="image_size"):
+            make_train_step(None, None,
+                            StepConfig(total_train_steps=10,
+                                       augment_in_step=True))
+
+
+class TestRawPipeline:
+    def _cfg(self, **task_overrides):
+        task = dict(task="fake", batch_size=8, image_size_override=16,
+                    augment_placement="step")
+        task.update(task_overrides)
+        return Config(task=TaskConfig(**task),
+                      device=DeviceConfig(num_replicas=1, seed=3))
+
+    def test_contract_raw_uint8_train_host_resize_eval(self):
+        bundle = get_loader(self._cfg(), num_fake_samples=16)
+        b = next(iter(bundle.train_loader))
+        assert sorted(b) == ["images", "label"]
+        assert b["images"].dtype == np.uint8
+        assert b["images"].shape == (8, 16, 16, 3)
+        assert b["label"].dtype == np.int32
+        # eval keeps the host resize path: two identical float32 views
+        tb = next(iter(bundle.test_loader))
+        np.testing.assert_array_equal(tb["view1"], tb["view2"])
+        assert tb["view1"].dtype == np.float32
+
+    def test_epoch_reseed_changes_order(self):
+        bundle = get_loader(self._cfg(), num_fake_samples=64)
+        bundle.set_all_epochs(0)
+        l0 = np.concatenate([b["label"] for b in bundle.train_loader])
+        l0b = np.concatenate([b["label"] for b in bundle.train_loader])
+        bundle.set_all_epochs(1)
+        l1 = np.concatenate([b["label"] for b in bundle.train_loader])
+        np.testing.assert_array_equal(l0, l0b)
+        assert not np.array_equal(l0, l1)
+
+    def test_drop_remainder(self):
+        bundle = get_loader(self._cfg(batch_size=12), num_fake_samples=64)
+        counts = [len(b["label"]) for b in bundle.train_loader]
+        assert counts == [12] * 5
+
+    def test_rejects_image_folder(self, tmp_path):
+        cfg = self._cfg(task="image_folder", data_dir=str(tmp_path))
+        with pytest.raises(ValueError, match="image_folder"):
+            get_loader(cfg)
+
+    def test_rejects_paper_aug_spec(self):
+        cfg = Config(task=TaskConfig(task="fake", batch_size=8,
+                                     image_size_override=16,
+                                     augment_placement="step"),
+                     regularizer=RegularizerConfig(aug_spec="paper"),
+                     device=DeviceConfig(num_replicas=1, seed=3))
+        with pytest.raises(ValueError, match="reference"):
+            get_loader(cfg, num_fake_samples=16)
+
+    def test_rejects_device_backend_combo(self):
+        cfg = self._cfg(data_backend="device")
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            get_loader(cfg, num_fake_samples=16)
+
+    def test_resolve_rejects_bogus_placement(self):
+        c = Config(task=TaskConfig(task="fake", batch_size=8,
+                                   augment_placement="chip"))
+        with pytest.raises(ValueError, match="augment_placement"):
+            config_lib.resolve(c, num_train_samples=64, num_test_samples=16,
+                               output_size=10, input_shape=(16, 16, 3))
+
+    def test_range_check_uint8_contract(self):
+        from byol_tpu.training.trainer import _range_check
+        _range_check({"images": np.zeros((2, 4, 4, 3), np.uint8)})
+        with pytest.raises(ValueError, match="uint8"):
+            _range_check({"images": np.zeros((2, 4, 4, 3), np.float32)})
+
+
+class TestInputPipelineMeter:
+    def test_accounting(self):
+        from byol_tpu.observability.meters import (InputPipelineMeter,
+                                                   input_log_line)
+        m = InputPipelineMeter(starvation_threshold_s=0.01)
+        m.record_produced(100, 1)
+        m.record_produced(300, 2)
+        m.record_first_fill(0.3)      # pipeline fill: NOT starvation
+        m.record_wait(0.002)          # under threshold: not starved
+        m.record_wait(0.5)            # starved
+        assert m.h2d_bytes_per_step() == 200.0
+        assert m.avg_queue_depth() == 1.5
+        assert m.starved_steps == 1
+        assert m.batches_consumed == 3
+        np.testing.assert_allclose(m.starved_seconds, 0.5)
+        np.testing.assert_allclose(m.wait_seconds, 0.502)
+        np.testing.assert_allclose(m.first_fill_seconds, 0.3)
+        r = m.result()
+        assert r["h2d_bytes_per_step"] == 200.0
+        assert r["input_starved_steps"] == 1.0
+        assert r["input_first_fill_seconds"] == 0.3
+        line = input_log_line(3, m)
+        assert "starved: 0.50 sec (1 steps)" in line
+        assert "fill: 0.30 sec" in line
+
+    def test_empty_meter_reads_zero(self):
+        from byol_tpu.observability.meters import InputPipelineMeter
+        m = InputPipelineMeter()
+        assert m.h2d_bytes_per_step() == 0.0
+        assert m.avg_queue_depth() == 0.0
+
+    def test_prefetch_feeds_the_meter(self, mesh8):
+        from byol_tpu.data.prefetch import prefetch_to_mesh
+        from byol_tpu.observability.meters import InputPipelineMeter
+        batches = [{"images": np.zeros((8, 4, 4, 3), np.uint8),
+                    "label": np.zeros((8,), np.int32)} for _ in range(5)]
+        per_batch = 8 * 4 * 4 * 3 + 8 * 4
+        meter = InputPipelineMeter()
+        out = list(prefetch_to_mesh(iter(batches), mesh8, meter=meter))
+        assert len(out) == 5
+        assert meter.batches_produced == 5
+        assert meter.batches_consumed == 5
+        assert meter.h2d_bytes_per_step() == float(per_batch)
+        assert meter.wait_seconds >= 0.0
+
+    def test_uint8_payload_is_8x_smaller_than_two_float_views(self):
+        """The tentpole's H2D arithmetic, pinned: raw uint8 vs two float32
+        views of the same geometry is exactly 8x."""
+        from byol_tpu.data.prefetch import host_nbytes
+        raw = {"images": np.zeros((4, 16, 16, 3), np.uint8)}
+        views = {"view1": np.zeros((4, 16, 16, 3), np.float32),
+                 "view2": np.zeros((4, 16, 16, 3), np.float32)}
+        assert host_nbytes(views) == 8 * host_nbytes(raw)
+
+    def test_host_nbytes_never_materializes_device_arrays(self):
+        """data_backend='device' loaders yield jax device arrays; the
+        producer-side byte count must come from metadata only — a
+        np.asarray there would force a blocking D2H copy of both views
+        per batch inside the prefetch producer (review finding, PR 3)."""
+        from byol_tpu.data.prefetch import host_nbytes
+
+        class _NoMaterialize:
+            """Array stand-in that forbids conversion to numpy."""
+            nbytes = 4 * 16 * 16 * 3 * 4
+            def __array__(self, *a, **k):
+                raise AssertionError("host_nbytes materialized the array")
+
+        assert host_nbytes({"view1": _NoMaterialize()}) == 4 * 16 * 16 * 3 * 4
+        # ShapeDtypeStruct-style leaves (no nbytes): shape/dtype fallback
+        import jax as _jax
+        sds = _jax.ShapeDtypeStruct((4, 16, 16, 3), np.uint8)
+        assert host_nbytes({"images": sds}) == 4 * 16 * 16 * 3
+
+    def test_first_batch_wait_is_fill_not_starvation(self, mesh8):
+        """A slow FIRST batch (producer startup) must land in
+        first_fill_seconds, not starved_seconds — otherwise every healthy
+        epoch reports one starved step."""
+        import time as _time
+        from byol_tpu.data.prefetch import prefetch_to_mesh
+        from byol_tpu.observability.meters import InputPipelineMeter
+
+        def source():
+            _time.sleep(0.15)     # producer startup / first-batch cost
+            for i in range(3):
+                yield {"x": np.full((8,), i, np.float32)}
+
+        meter = InputPipelineMeter(starvation_threshold_s=0.05)
+        out = list(prefetch_to_mesh(source(), mesh8, meter=meter))
+        assert len(out) == 3
+        assert meter.batches_consumed == 3
+        assert meter.first_fill_seconds >= 0.1
+        assert meter.starved_seconds < 0.1   # fill excluded from starvation

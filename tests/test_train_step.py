@@ -80,8 +80,15 @@ class TestTrainStep:
             state, metrics = train_step(state, batch)
             losses.append(float(metrics["loss_mean"]))
         assert all(np.isfinite(losses))
-        # BYOL loss on repeated data should trend down.
-        assert losses[-1] < losses[0]
+        # The stream alternates TWO fixed batches (seed = i % 2) whose
+        # intrinsic loss levels differ by ~0.2 — comparing losses[-1]
+        # (a seed-1 step) against losses[0] (a seed-0 step) was the
+        # documented flake: it measured the batch gap, not learning.
+        # Compare each batch against ITSELF: both parities must trend
+        # down over the repeated-data run (deterministic under the fixed
+        # seeds; measured margins ~0.07/0.09 at head).
+        assert losses[-2] < losses[0]      # seed-0 stream (steps 0 -> 6)
+        assert losses[-1] < losses[1]      # seed-1 stream (steps 1 -> 7)
 
     @pytest.mark.slow
     def test_ema_and_counters_move(self, training, mesh8_module):

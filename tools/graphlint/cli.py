@@ -9,11 +9,13 @@ no jax/TPU initialization — the whole point is rejecting bad programs
 from __future__ import annotations
 
 import argparse
+import json
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from tools.graphlint import engine
-from tools.graphlint.reporters import json_report, text_report
+from tools.graphlint.reporters import (json_report, suppression_counts,
+                                       text_report)
 from tools.graphlint.rules import all_rules
 
 
@@ -32,9 +34,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "AND evidence/graphlint.json")
     p.add_argument("--select", default=None,
                    help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--trend-baseline", default=None,
+                   help="path to a committed JSON report (schema >= 2); "
+                        "FAIL (exit 1) when any rule's suppression count "
+                        "grew vs it — the lint-debt ratchet.  A missing "
+                        "baseline file is skipped with a note (first run); "
+                        "on an alarm, --out is NOT written, so the grown "
+                        "count can never silently become the new baseline")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
+
+
+def trend_alarms(current: Dict[str, int], baseline: Dict[str, int]
+                 ) -> List[str]:
+    """Rules whose suppression count GREW vs the baseline (shrinking and
+    new-rule-at-zero are fine; growth is new suppressed debt)."""
+    return [f"{rule}: {baseline.get(rule, 0)} -> {n}"
+            for rule, n in sorted(current.items())
+            if n > baseline.get(rule, 0)]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -60,13 +78,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         report = text_report(findings, files)
     print(report, end="" if report.endswith("\n") else "\n")
-    if args.out:
+    alarms: List[str] = []
+    if args.trend_baseline:
+        try:
+            with open(args.trend_baseline, encoding="utf-8") as fh:
+                baseline = json.load(fh)
+        except FileNotFoundError:
+            print(f"graphlint: trend baseline {args.trend_baseline} not "
+                  "found; skipping the suppression-trend check (first run)",
+                  file=sys.stderr)
+            baseline = None
+        except ValueError as e:
+            print(f"graphlint: trend baseline {args.trend_baseline} is not "
+                  f"valid JSON ({e}); failing rather than ratcheting "
+                  "against garbage", file=sys.stderr)
+            return 2
+        if baseline is not None:
+            alarms = trend_alarms(suppression_counts(files),
+                                  baseline.get("suppressions_by_rule", {}))
+            for a in alarms:
+                print(f"graphlint: trend alarm: suppressions grew for {a} "
+                      f"(vs {args.trend_baseline}); remove the suppression "
+                      "or update the baseline deliberately",
+                      file=sys.stderr)
+    if args.out and not alarms:
+        # an alarmed run must not rewrite the evidence file: the grown
+        # count would become the new baseline and the ratchet would vanish
         out_report = (json_report(findings, files, args.paths)
                       if args.out.endswith(".json") else report)
         with open(args.out, "w", encoding="utf-8") as fh:
             fh.write(out_report if out_report.endswith("\n")
                      else out_report + "\n")
-    return 1 if findings else 0
+    return 1 if (findings or alarms) else 0
 
 
 if __name__ == "__main__":

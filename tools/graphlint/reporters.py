@@ -10,7 +10,10 @@ from typing import Dict, List, Sequence
 
 from tools.graphlint.engine import Finding, LintedFile
 
-SCHEMA_VERSION = 1
+# v2: + suppressions_by_rule (the trend-alarm input — ROADMAP rule-wave-2
+# item d: CI fails when a rule's suppression count grows vs the committed
+# evidence file)
+SCHEMA_VERSION = 2
 
 
 def text_report(findings: Sequence[Finding],
@@ -20,6 +23,22 @@ def text_report(findings: Sequence[Finding],
     lines.append(f"graphlint: {len(findings)} finding(s) in "
                  f"{len(files)} file(s) scanned")
     return "\n".join(lines)
+
+
+def suppression_counts(files: Sequence[LintedFile]) -> Dict[str, int]:
+    """Suppression-comment count per rule id across the linted tree
+    (``disable=all`` counted under ``"all"``).  Each comment counts once
+    even though suppress-above style registers it on two lines."""
+    counts: Dict[str, int] = {}
+    for f in files:
+        seen: set = set()
+        for sup in f.suppressions.values():
+            if id(sup) in seen:
+                continue
+            seen.add(id(sup))
+            for rule in sup.rules:
+                counts[rule] = counts.get(rule, 0) + 1
+    return dict(sorted(counts.items()))
 
 
 def json_report(findings: Sequence[Finding],
@@ -36,6 +55,7 @@ def json_report(findings: Sequence[Finding],
             {"rule": fd.rule, "path": fd.path, "line": fd.line,
              "col": fd.col, "message": fd.message} for fd in findings],
         "counts_by_rule": dict(sorted(counts.items())),
+        "suppressions_by_rule": suppression_counts(files),
         "clean": not findings,
     }
     return json.dumps(payload, indent=2, sort_keys=True) + "\n"
