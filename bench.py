@@ -62,6 +62,16 @@ Usage:
                                    #   row records h2d_bytes_per_step + HBM
                                    #   high-water (same compile gating as
                                    #   --accum-ladder)
+  python bench.py --telemetry-ab   # telemetry-overhead A/B: --telemetry off
+                                   #   vs step @ --telemetry-interval 50,
+                                   #   full observation cost (in-graph
+                                   #   health vector + lagged sink
+                                   #   readback); budget < 2%
+
+Every run also appends structured events (run header + one ``bench_row``
+per measured config) to ``bench_events.jsonl`` — the same schema-versioned
+JSONL format trainer.fit writes as ``run.jsonl``
+(byol_tpu/observability/events.py), so one reader parses runs and benches.
 """
 from __future__ import annotations
 
@@ -155,7 +165,7 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
            stem: str = "conv", attn_impl: str = "dense",
            accum_steps: int = 1, accum_bn_mode: str = "average",
            remat_policy: str = "none", augment_placement: str = "loader",
-           materialize_batch: bool = True):
+           telemetry: str = "off", materialize_batch: bool = True):
     from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
                                       OptimConfig, ParityConfig, TaskConfig,
                                       resolve)
@@ -173,7 +183,8 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
                           stem=stem, attn_impl=attn_impl),
         optim=OptimConfig(accum_steps=accum_steps,
                           accum_bn_mode=accum_bn_mode),
-        device=DeviceConfig(num_replicas=n_dev, half=half, seed=0),
+        device=DeviceConfig(num_replicas=n_dev, half=half, seed=0,
+                            telemetry=telemetry),
         parity=ParityConfig(ema_update_mode=ema_update_mode),
     )
     rcfg = resolve(cfg, num_train_samples=1_281_167, num_test_samples=50_000,
@@ -403,9 +414,42 @@ def _flush_partial():
         print(f"bench: could not write {_PARTIAL_PATH}: {e}", file=sys.stderr)
 
 
+_events = None          # observability.events.RunLog, opened by main()
+
+
+def _open_events(path: str = "bench_events.jsonl") -> None:
+    """Open the structured bench event log (same JSONL schema as
+    trainer.fit's run.jsonl, observability/events.py) and stamp the run
+    header.  Deliberately backend-client-free: the header reads only the
+    static jax config, so it is safe to call BEFORE the accum-ladder gate
+    children claim the single-client TPU.  RunLog(best_effort=True)
+    swallows construction and write failures alike — a read-only fs must
+    not kill the measurement (same contract as _flush_partial)."""
+    global _events
+    from byol_tpu.observability.events import RunLog
+    _events = RunLog(path, best_effort=True)
+    _events.emit("run_header",
+                 config={"argv": sys.argv[1:], "tool": "bench.py"},
+                 jax_version=jax.__version__,
+                 backend=str(jax.config.jax_platforms or "auto"))
+
+
 def _record(name: str, **fields):
+    global _events
     _partial["results"].append({"config": name, **fields})
     _flush_partial()
+    if _events is not None:
+        # every bench row doubles as a structured event — one reader
+        # (observability/events.py) parses runs and benches alike.
+        # Best-effort like _flush_partial: a disk that fills mid-sweep
+        # must not kill hours of measurement.
+        try:
+            _events.emit("bench_row", config=name, **fields)
+        except (OSError, TypeError, ValueError) as e:
+            print(f"bench: event log write failed ({e!r}); disabling "
+                  "bench_events.jsonl for the rest of the run",
+                  file=sys.stderr)
+            _events = None
 
 
 # Killable backend preflight — shared with the train CLI (which learned the
@@ -503,6 +547,12 @@ def main():
     if attn_impl != "dense":
         _PARTIAL_PATH = _PARTIAL_PATH.replace(
             ".json", f"_{attn_impl}.json")
+    if "--dry-compile" not in sys.argv[1:]:
+        # --dry-compile is also the accum/input-ladder GATE CHILD body: a
+        # header per child would interleave N+1 run_headers into the
+        # parent sweep's event stream (and a standalone dry-compile emits
+        # its one JSON line on stdout — nothing to log here either)
+        _open_events()
     # Persistent compile cache: every config's XLA compile costs minutes over
     # the tunneled backend; caching makes sweep re-runs (and headline re-runs
     # after a mid-sweep backend drop) nearly free to resume.
@@ -511,7 +561,8 @@ def main():
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     if not _preflight_backend():
         mode = {"--sweep", "--profile", "--stem-ab", "--mvc",
-                "--accum-ladder", "--dry-compile", "--input-ladder"} \
+                "--accum-ladder", "--dry-compile", "--input-ladder",
+                "--telemetry-ab"} \
             & set(sys.argv[1:])
         if mode:
             # only the headline has a committed artifact to fall back to;
@@ -638,6 +689,9 @@ def main():
     if "--input-ladder" in sys.argv[1:]:
         _input_ladder(arch, image_size, on_tpu, mfu_of, attn_impl,
                       input_gates)
+        return
+    if "--telemetry-ab" in sys.argv[1:]:
+        _telemetry_ab(arch, image_size, on_tpu, attn_impl)
         return
 
     value = best_throughput("tpu_first", half=True, fuse_views=True,
@@ -1388,6 +1442,65 @@ def _input_ladder(arch, image_size, on_tpu, mfu_of, attn_impl, gates):
                       "complete": not _backend_dead}))
     if _backend_dead:
         raise SystemExit(3)   # same truncation contract as --sweep
+
+
+def _telemetry_ab(arch, image_size, on_tpu, attn_impl):
+    """Telemetry-overhead A/B (``--telemetry-ab``): the SAME config measured
+    with ``telemetry='off'`` (the exact pre-telemetry graph — pinned by the
+    HLO-identity test) and ``telemetry='step'`` with the TelemetrySink
+    polling at ``--telemetry-interval`` (default 50) in the timing loop —
+    i.e. the FULL observation cost: the in-graph health reductions plus the
+    sink's lagged explicit device_get.  Prints one JSON line with both
+    rates and ``overhead_pct``; the acceptance budget is < 2%.
+    """
+    from byol_tpu.observability.telemetry import TelemetrySink
+    interval = _int_flag("--telemetry-interval", 50)
+    # CPU rung: smallest batch that still pays >= one interval-50 sink
+    # readback in the timing loop — the 1-core box sustains ~0.5 step/s on
+    # the fallback model, so 55 steps x 2 arms is minutes, not tens
+    bs = 256 if on_tpu else 16
+    steps = 120 if on_tpu else 55
+    rates = {}
+    for mode in ("off", "step"):
+        state, train_step, batch, mesh = _build(
+            bs, image_size, arch, half=on_tpu, fuse_views=True,
+            ema_update_mode="post", attn_impl=attn_impl, telemetry=mode)
+        compiled, stats = _aot_compile(train_step, state, batch, mesh)
+        sink = (TelemetrySink(interval, nan_policy="warn", verbose=False)
+                if mode == "step" else None)
+        for _ in range(3):                       # warm; sync via readback
+            state, metrics = compiled(state, batch)
+        float(metrics["loss_mean"])
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, metrics = compiled(state, batch)
+            if sink is not None:
+                sink.offer(i + 1, metrics["health"])
+        if sink is not None:
+            sink.drain()
+        float(metrics["loss_mean"])
+        dt = time.perf_counter() - t0
+        n_dev = len(jax.devices())
+        rates[mode] = batch["label"].shape[0] * steps / dt / n_dev
+        _record(f"telemetry_{mode}", fit=True, batch_per_chip=bs,
+                telemetry=mode,
+                telemetry_interval=interval if mode == "step" else None,
+                images_per_sec_per_chip=round(rates[mode], 2), **stats)
+        print(f"bench: telemetry_{mode}: {rates[mode]:.1f} img/s/chip",
+              file=sys.stderr)
+    overhead = 1.0 - rates["step"] / rates["off"]
+    print(json.dumps({
+        "metric": "telemetry_step_overhead_pct",
+        "value": round(100.0 * overhead, 2),
+        "unit": "%",
+        "vs_baseline": None,
+        "off_images_per_sec_per_chip": round(rates["off"], 2),
+        "step_images_per_sec_per_chip": round(rates["step"], 2),
+        "telemetry_interval": interval,
+        "batch_per_chip": bs, "arch": arch, "image_size": image_size,
+        "timing_steps": steps,
+        "device_kind": jax.devices()[0].device_kind,
+    }))
 
 
 def _sweep_prior_rows() -> dict:

@@ -111,7 +111,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="single minibatch per train/eval pass (main.py:110)")
     d.add_argument("--seed", type=int, default=1234)
     d.add_argument("--check-numerics", action="store_true",
-                   help="fail fast on NaN/inf (jax_debug_nans)")
+                   help="fail fast on NaN/inf (jax_debug_nans; legacy "
+                        "blanket check — prefer --telemetry with "
+                        "--nan-policy, whose in-graph nonfinite count "
+                        "costs no per-op host sync)")
+    d.add_argument("--telemetry", type=str, default="off",
+                   choices=("off", "epoch", "step"),
+                   help="in-graph training-health telemetry "
+                        "(observability/health.py): 'off' lowers the "
+                        "exact pre-telemetry step; 'epoch' reads one "
+                        "health record per epoch at the existing "
+                        "readback; 'step' reads back asynchronously "
+                        "(>= interval-step lag, no host sync in the "
+                        "dispatch loop) every --telemetry-interval steps")
+    d.add_argument("--telemetry-interval", type=int, default=50,
+                   help="optimizer steps between sampled health records "
+                        "under --telemetry step")
+    d.add_argument("--nan-policy", type=str, default="warn",
+                   choices=("warn", "halt"),
+                   help="response to a non-finite gradient/loss in the "
+                        "telemetry health vector: 'warn' records an "
+                        "anomaly event; 'halt' dumps step/state metadata "
+                        "to the run log and raises")
     d.add_argument("--fault-at-step", type=int, default=0,
                    help="fault injection: kill the process at step N "
                         "(tests checkpoint/resume)")
@@ -296,6 +317,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
             distributed_port=args.distributed_port,
             debug_step=args.debug_step, seed=args.seed, half=args.half,
             check_numerics=args.check_numerics,
+            telemetry=args.telemetry,
+            telemetry_interval=args.telemetry_interval,
+            nan_policy=args.nan_policy,
             fault_at_step=args.fault_at_step,
             save_on_signal=args.save_on_signal,
             watchdog_timeout=args.watchdog_timeout,
@@ -378,13 +402,18 @@ def main(argv: Optional[List[str]] = None) -> int:
           + (f" (MFU {result.mfu:.1%})" if result.mfu is not None else ""))
     if args.linear_eval:
         import jax
+        from byol_tpu.observability.watchdog import Watchdog
         from byol_tpu.training.linear_eval import run_linear_eval_from_cfg
         # Multi-host: SPMD extraction over the training mesh — every host
         # computes and prints the identical result (linear_eval.py module
-        # docstring).  Single-host: plain single-jit path.
+        # docstring).  Single-host: plain single-jit path.  The trainer's
+        # watchdog stopped with fit(); the extraction readbacks are their
+        # own pod-blocking windows, so they get their own.
         mesh = result.mesh if jax.process_count() > 1 else None
-        le = run_linear_eval_from_cfg(cfg, result.state, loader=loader,
-                                      mesh=mesh, seed=cfg.device.seed)
+        with Watchdog(cfg.device.watchdog_timeout) as wd:
+            le = run_linear_eval_from_cfg(cfg, result.state, loader=loader,
+                                          mesh=mesh, seed=cfg.device.seed,
+                                          watchdog=wd)
         print(f"linear_eval(offline): top1 {le.top1:.2f} "
               f"top5 {le.top5:.2f} (train acc {le.train_acc:.2f}, "
               f"{le.num_train} train / {le.num_test} test)")

@@ -169,6 +169,22 @@ class DeviceConfig:
     seed: int = 1234
     # Aux hygiene (SURVEY.md §5.2/§5.3 — absent in the reference):
     check_numerics: bool = False        # jax_debug_nans: fail fast on NaN/inf
+                                        # (legacy blanket check; prefer
+                                        # --telemetry + --nan-policy: the
+                                        # in-graph nonfinite count costs no
+                                        # per-op host sync)
+    # Training-health telemetry (observability/{health,telemetry,events}):
+    telemetry: str = "off"              # 'off' (identical HLO to a pre-
+                                        # telemetry step) | 'epoch' (one
+                                        # health record at the epoch
+                                        # readback) | 'step' (async lagged
+                                        # readback every telemetry_interval
+                                        # optimizer steps)
+    telemetry_interval: int = 50        # optimizer steps between sampled
+                                        # health records under 'step'
+    nan_policy: str = "warn"            # non-finite grads/loss response:
+                                        # 'warn' (anomaly event) | 'halt'
+                                        # (state-dump event + raise)
     fault_at_step: int = 0              # >0: kill the process at step N to
                                         # exercise preemption/resume paths
     save_on_signal: bool = True         # SIGTERM (pod preemption notice) ->
@@ -306,6 +322,26 @@ def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
         raise ValueError(
             f"unknown augment_placement {cfg.task.augment_placement!r}; "
             "'loader' | 'step'")
+    if cfg.device.telemetry not in ("off", "epoch", "step"):
+        raise ValueError(
+            f"unknown telemetry mode {cfg.device.telemetry!r}; "
+            "'off' | 'epoch' | 'step'")
+    if cfg.device.telemetry_interval < 1:
+        raise ValueError(
+            f"telemetry_interval must be >= 1, got "
+            f"{cfg.device.telemetry_interval}")
+    if cfg.device.nan_policy not in ("warn", "halt"):
+        raise ValueError(
+            f"unknown nan_policy {cfg.device.nan_policy!r}; "
+            "'warn' | 'halt'")
+    if cfg.device.nan_policy == "halt" and cfg.device.telemetry == "off":
+        # the sink that enforces halt only exists when telemetry is on —
+        # accepting this combination would silently train through NaNs,
+        # the exact failure the policy exists to stop
+        raise ValueError(
+            "--nan-policy halt requires --telemetry epoch|step (the "
+            "non-finite check lives in the telemetry health vector; with "
+            "telemetry off nothing would enforce the halt)")
     from byol_tpu.core.remat import resolve_policy_name
     resolve_policy_name(cfg.model.remat, cfg.model.remat_policy)  # fail fast
     per_replica_batch = cfg.task.batch_size // n_rep

@@ -2,8 +2,8 @@ from byol_tpu.observability.grapher import Grapher, make_grid
 from byol_tpu.observability.meters import (InputPipelineMeter,
                                            MetricAccumulator, StepTimer,
                                            epoch_log_line, input_log_line)
-from byol_tpu.observability import flops, profiling
+from byol_tpu.observability import events, flops, health, profiling, telemetry
 
 __all__ = ["Grapher", "make_grid", "InputPipelineMeter", "MetricAccumulator",
-           "StepTimer", "epoch_log_line", "input_log_line", "flops",
-           "profiling"]
+           "StepTimer", "epoch_log_line", "input_log_line", "events",
+           "flops", "health", "profiling", "telemetry"]

@@ -1,0 +1,200 @@
+"""Asynchronous telemetry sink: lagged health readback + anomaly rules.
+
+The train step computes the packed health vector IN-GRAPH
+(observability/health.py); this module is the host side that reads it back
+WITHOUT ever synchronizing the dispatch loop:
+
+- ``offer(step, vec)`` enqueues the device vector every
+  ``interval``-th optimizer step and reads back only entries OLDER than
+  the newest one — so by the time a vector is materialized on the host, at
+  least ``interval`` further steps have been dispatched and the readback
+  finds a value that is (almost surely) already computed.  The hot loop
+  never blocks on the current step; worst case it briefly joins an
+  interval-old value.  The transfer is an EXPLICIT ``jax.device_get``, so
+  the sink runs clean under ``jax.transfer_guard("disallow")`` (the
+  ``guard_steps`` test fixture) — implicit-sync hygiene is preserved.
+- ``hold(step, vec)``/``drain()`` support ``--telemetry epoch``: the
+  trainer holds the latest vector (rebinding a tuple, no readback) and
+  drains once at the epoch boundary — AFTER the epoch metric readback has
+  already synchronized, so the epoch record is free.
+
+Anomaly rules run over a ring buffer of processed records:
+
+- ``nonfinite``: ``nonfinite_count > 0`` in the gradients/loss.  Under
+  ``nan_policy='halt'`` the sink emits the anomaly + a halt event and
+  raises :class:`NanHaltError` (the trainer adds a state-dump event) —
+  the per-step, zero-sync replacement for blanket ``jax_debug_nans``.
+- ``collapse``: the BYOL collapse signature — target-projection
+  per-feature std below ``collapse_feature_std`` OR mean pairwise cosine
+  above ``collapse_cosine`` — the failure the loss curve hides.
+- ``step_time_spike``: seconds/optimizer-step (from the enqueue
+  timestamps, i.e. dispatch-to-dispatch time) above ``step_time_spike``x
+  the median of the ring — a wedging input pipeline or a slowing chip.
+"""
+from __future__ import annotations
+
+import sys
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from byol_tpu.observability import health as health_lib
+from byol_tpu.observability.events import RunLog
+
+NAN_POLICIES = ("warn", "halt")
+
+
+class NanHaltError(RuntimeError):
+    """A non-finite gradient/loss surfaced under ``--nan-policy halt``."""
+
+    def __init__(self, step: int, record: Dict[str, float]):
+        self.step = step
+        self.record = record
+        super().__init__(
+            f"non-finite values in gradients/loss at optimizer step {step} "
+            f"(nonfinite_count={record.get('nonfinite_count')}, "
+            f"loss={record.get('loss')}); halting per --nan-policy halt")
+
+
+class TelemetrySink:
+    """Lagged readback + anomaly detection over the in-graph health vector.
+
+    ``events`` (observability.events.RunLog, optional): every processed
+    sample is emitted as a ``step`` event and every tripped rule as an
+    ``anomaly`` event.  ``records`` is the ring buffer of processed
+    samples (dicts keyed by HEALTH_FIELDS + ``step``/``sec_per_step``);
+    ``anomalies`` accumulates every anomaly for the run.
+    """
+
+    def __init__(self, interval: int = 50, *, nan_policy: str = "warn",
+                 events: Optional[RunLog] = None, ring: int = 128,
+                 collapse_feature_std: float = 1e-3,
+                 collapse_cosine: float = 0.995,
+                 step_time_spike: float = 3.0,
+                 verbose: bool = True) -> None:
+        if interval < 1:
+            raise ValueError(f"telemetry interval must be >= 1: {interval}")
+        if nan_policy not in NAN_POLICIES:
+            raise ValueError(
+                f"unknown nan_policy {nan_policy!r}; one of {NAN_POLICIES}")
+        self.interval = interval
+        self.nan_policy = nan_policy
+        self.events = events
+        self.collapse_feature_std = collapse_feature_std
+        self.collapse_cosine = collapse_cosine
+        self.step_time_spike = step_time_spike
+        self.verbose = verbose
+        self.records: Deque[Dict[str, float]] = deque(maxlen=ring)
+        self.anomalies: List[Dict[str, Any]] = []
+        # (step, device vector, dispatch wall-time) awaiting readback
+        self._pending: Deque[Tuple[int, Any, float]] = deque()
+        self._held: Optional[Tuple[int, Any, float]] = None
+
+    # ---- hot-loop side ----------------------------------------------------
+    def offer(self, step: int, vec: Any,
+              wall: Optional[float] = None) -> List[Dict[str, Any]]:
+        """'step' mode: sample every ``interval``-th step; process only
+        samples at least one interval old (the async-lag contract).
+        Returns the anomalies found in the samples processed THIS call.
+        ``wall`` overrides the dispatch timestamp (tests)."""
+        if step % self.interval:
+            return []
+        self._pending.append(
+            (step, vec, time.perf_counter() if wall is None else wall))
+        out: List[Dict[str, Any]] = []
+        while len(self._pending) > 1:
+            out.extend(self._process(*self._pending.popleft()))
+        return out
+
+    def hold(self, step: int, vec: Any,
+             wall: Optional[float] = None) -> None:
+        """'epoch' mode: remember the newest vector without reading it;
+        :meth:`drain` at the epoch boundary turns it into one record."""
+        self._held = (step, vec,
+                      time.perf_counter() if wall is None else wall)
+
+    def drain(self) -> List[Dict[str, Any]]:
+        """Process everything outstanding (epoch boundary / shutdown).
+        Called after a synchronizing readback, so the device_gets here are
+        free; anomalies found are returned (and halt still raises)."""
+        out: List[Dict[str, Any]] = []
+        while self._pending:
+            out.extend(self._process(*self._pending.popleft()))
+        if self._held is not None:
+            held, self._held = self._held, None
+            out.extend(self._process(*held))
+        # drain marks an epoch boundary: the wall-clock gap to the next
+        # epoch's first sample spans eval/valid/checkpoint, not training —
+        # invalidate the timebase so that sample carries no sec_per_step
+        # (a spurious step_time_spike every epoch would poison the one
+        # anomaly feed this feature exists to keep trustworthy)
+        if self.records:
+            self.records[-1].pop("_wall", None)
+        return out
+
+    # ---- readback + rules -------------------------------------------------
+    def _process(self, step: int, vec: Any,
+                 wall: float) -> List[Dict[str, Any]]:
+        # EXPLICIT transfer: legitimate under transfer_guard("disallow").
+        arr = np.asarray(jax.device_get(vec), np.float32)
+        rec: Dict[str, float] = {"step": float(step),
+                                 **health_lib.unpack(arr)}
+        prev = self.records[-1] if self.records else None
+        if prev is not None and "_wall" in prev and step > prev["step"]:
+            rec["sec_per_step"] = ((wall - prev["_wall"])
+                                   / (step - prev["step"]))
+        rec["_wall"] = wall
+        anomalies = self._rules(step, rec)
+        self.records.append(rec)
+        public = {k: v for k, v in rec.items() if not k.startswith("_")}
+        if self.events is not None:
+            self.events.emit("step", step=step, health=public,
+                             anomalies=[a["rule"] for a in anomalies])
+            for a in anomalies:
+                self.events.emit("anomaly", **a)
+        self.anomalies.extend(anomalies)
+        if self.verbose:
+            for a in anomalies:
+                print(f"telemetry: ANOMALY {a['rule']} at step {step}: "
+                      f"{a['detail']}", file=sys.stderr)
+        if rec["nonfinite_count"] > 0 and self.nan_policy == "halt":
+            if self.events is not None:
+                self.events.emit("halt", step=step, reason="nonfinite",
+                                 health=public)
+            raise NanHaltError(step, public)
+        return anomalies
+
+    def _rules(self, step: int,
+               rec: Dict[str, float]) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+
+        def anomaly(rule: str, detail: str) -> None:
+            out.append({"step": step, "rule": rule, "detail": detail,
+                        "health": {k: v for k, v in rec.items()
+                                   if not k.startswith("_")}})
+
+        if rec["nonfinite_count"] > 0:
+            anomaly("nonfinite",
+                    f"{rec['nonfinite_count']:.0f} non-finite value(s) in "
+                    f"gradients/loss (loss={rec['loss']})")
+        if (rec["collapse_feature_std"] < self.collapse_feature_std
+                or rec["collapse_cosine_mean"] > self.collapse_cosine):
+            anomaly("collapse",
+                    "target projections collapsing: feature_std="
+                    f"{rec['collapse_feature_std']:.3e} (< "
+                    f"{self.collapse_feature_std}) or cosine_mean="
+                    f"{rec['collapse_cosine_mean']:.4f} (> "
+                    f"{self.collapse_cosine})")
+        sec = rec.get("sec_per_step")
+        history = [r["sec_per_step"] for r in self.records
+                   if "sec_per_step" in r]
+        if sec is not None and len(history) >= 5:
+            med = float(np.median(history))
+            if med > 0 and sec > self.step_time_spike * med:
+                anomaly("step_time_spike",
+                        f"{sec:.3f}s/step vs ring median {med:.3f}s "
+                        f"(x{sec / med:.1f} > x{self.step_time_spike})")
+        return out

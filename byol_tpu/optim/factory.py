@@ -49,6 +49,15 @@ def _base_optimizer(name: str, learning_rate) -> optax.GradientTransformation:
     raise ValueError(f"unknown optimizer {name!r}")
 
 
+def is_lars_optimizer(opt_name: str) -> bool:
+    """Does this optimizer string build the LARS wrapper chain?  The ONE
+    predicate shared by the factory and the telemetry plumbing (build.py
+    ``StepConfig.lars_in_chain``) — a second copy that normalized the
+    string differently would make the health vector report identity trust
+    ratios for a run where LARS is actually scaling updates."""
+    return opt_name.lower().strip().startswith("lars_")
+
+
 def build_optimizer(opt_name: str, *,
                     base_lr: float,
                     global_batch_size: int,
@@ -73,7 +82,7 @@ def build_optimizer(opt_name: str, *,
         raise ValueError(
             "bare 'lars' is a wrapper, not an optimizer; use lars_<base>, "
             "e.g. 'lars_momentum' (the reference default, main.py:88-89)")
-    is_lars = full.startswith("lars_")
+    is_lars = is_lars_optimizer(full)
     name = full.split("_")[-1] if is_lars else full
 
     lr = sched_lib.linear_scaled_lr(base_lr, global_batch_size, name)

@@ -50,6 +50,52 @@ class LarsState(NamedTuple):
     pass
 
 
+def _leaf_trust_ratio(g: jnp.ndarray, p: jnp.ndarray,
+                      trust_coefficient: float, eps: float) -> jnp.ndarray:
+    """The per-layer-group LARS trust ratio (lars.py:100-108), fp32 scalar.
+
+    ONE implementation shared by the optimizer transform below and the
+    telemetry stats (:func:`trust_ratio_vector`), so the health vector can
+    never report a different ratio than the update applied.
+    """
+    g32 = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    param_norm = jnp.linalg.norm(p32)
+    grad_norm = jnp.linalg.norm(g32)
+    return jnp.where(
+        (param_norm > 0.0) & (grad_norm > 0.0),
+        trust_coefficient * param_norm / (grad_norm + eps),
+        1.0)
+
+
+def trust_ratio_vector(updates: Any, params: Any,
+                       trust_coefficient: float = 1e-3,
+                       eps: float = 0.0,
+                       mask: Optional[MaskOrFn] = None) -> jnp.ndarray:
+    """Per-layer-group trust ratios as one stacked fp32 vector.
+
+    The optional stats output alongside :func:`scale_by_lars_trust_ratio`:
+    the same per-leaf ratio the transform multiplies in, for every ADAPTED
+    leaf (the default bias/BN exclusion mask), in flattened-tree order —
+    the health vector reports its min/median/max (observability/health.py).
+    Pure function of (updates, params): usable in-graph without touching
+    optimizer state.  Defaults mirror the factory (trust_coef=1e-3, eps=0).
+    NB ``updates`` must be whatever the transform actually sees at its
+    position in the chain — :func:`lars` folds weight decay into the
+    gradient FIRST, so callers replicate that fold-in (training/steps.py
+    does) or the reported ratios drift from the applied ones.
+    """
+    m = _resolve_mask(mask, params)
+    g_leaves = jax.tree_util.tree_leaves(updates)
+    p_leaves = jax.tree_util.tree_leaves(params)
+    m_leaves = jax.tree_util.tree_leaves(m)
+    ratios = [_leaf_trust_ratio(g, p, trust_coefficient, eps)
+              for g, p, use in zip(g_leaves, p_leaves, m_leaves) if use]
+    if not ratios:       # nothing adapted (all-1D tree): ratio is identity
+        return jnp.ones((1,), jnp.float32)
+    return jnp.stack(ratios)
+
+
 def scale_by_lars_trust_ratio(trust_coefficient: float = 1e-3,
                               eps: float = 0.0,
                               mask: Optional[MaskOrFn] = None
@@ -68,15 +114,8 @@ def scale_by_lars_trust_ratio(trust_coefficient: float = 1e-3,
         def scale(g, p, use):
             if not use:
                 return g
-            g32 = g.astype(jnp.float32)
-            p32 = p.astype(jnp.float32)
-            param_norm = jnp.linalg.norm(p32)
-            grad_norm = jnp.linalg.norm(g32)
-            ratio = jnp.where(
-                (param_norm > 0.0) & (grad_norm > 0.0),
-                trust_coefficient * param_norm / (grad_norm + eps),
-                1.0)
-            return (g32 * ratio).astype(g.dtype)
+            ratio = _leaf_trust_ratio(g, p, trust_coefficient, eps)
+            return (g.astype(jnp.float32) * ratio).astype(g.dtype)
 
         updates = jax.tree_util.tree_map(scale, updates, params, m)
         return updates, state

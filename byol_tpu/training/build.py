@@ -23,7 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from byol_tpu.core.config import Config, ResolvedConfig
 from byol_tpu.core.precision import get_policy
 from byol_tpu.models.byol_net import BYOLNet, build_byol_net
-from byol_tpu.optim.factory import build_optimizer
+from byol_tpu.optim.factory import build_optimizer, is_lars_optimizer
 from byol_tpu.parallel.mesh import DATA_AXIS
 from byol_tpu.training.state import TrainState, create_train_state
 from byol_tpu.training.steps import StepConfig, make_eval_step, make_train_step
@@ -129,7 +129,10 @@ def step_config(rcfg: ResolvedConfig) -> StepConfig:
         augment_in_step=cfg.task.augment_placement == "step",
         image_size=rcfg.input_shape[0],
         color_jitter_strength=cfg.regularizer.color_jitter_strength,
-        aug_seed=cfg.device.seed)
+        aug_seed=cfg.device.seed,
+        telemetry=cfg.device.telemetry,
+        weight_decay=cfg.regularizer.weight_decay,
+        lars_in_chain=is_lars_optimizer(cfg.optim.optimizer))
 
 
 def _validate_remat_tags(net, rcfg: ResolvedConfig, variables,

@@ -63,15 +63,24 @@ class LinearEvalResult:
 
 
 def extract_features(apply_fn: Callable, batches: Iterator[Dict[str, Any]],
-                     *, view: str = "view1") -> Tuple[np.ndarray, np.ndarray]:
+                     *, view: str = "view1",
+                     watchdog: Optional[Any] = None
+                     ) -> Tuple[np.ndarray, np.ndarray]:
     """Run the frozen encoder over a loader; returns (features, labels).
 
     ``apply_fn(images) -> representations`` must be jitted by the caller
     (one compile; batches share the loader's fixed shape except a possible
-    final remainder, which is padded here to reuse the executable)."""
+    final remainder, which is padded here to reuse the executable).
+
+    ``watchdog`` (observability.watchdog.Watchdog, optional): petted per
+    batch — every ``np.asarray(apply_fn(...))`` below is a blocking D2H
+    readback, so a wedged backend during linear-eval extraction is caught
+    exactly like a wedged train-epoch readback."""
     feats, labels = [], []
     fixed = None
     for batch in batches:
+        if watchdog is not None:
+            watchdog.pet()
         x = np.asarray(batch[view])
         y = np.asarray(batch["label"])
         n = len(y)
@@ -111,7 +120,8 @@ def encoder_extractor_spmd(net, state, mesh, *, half: bool = False,
 def extract_features_spmd(apply_fn, batches: Iterator[Dict[str, Any]], mesh,
                           *, host_batch: int, view: str = "view1",
                           replicated_data: bool = False,
-                          sample_shape: Optional[Tuple[int, ...]] = None
+                          sample_shape: Optional[Tuple[int, ...]] = None,
+                          watchdog: Optional[Any] = None
                           ) -> Tuple[np.ndarray, np.ndarray]:
     """Multi-host feature extraction over per-host loader shards.
 
@@ -142,6 +152,11 @@ def extract_features_spmd(apply_fn, batches: Iterator[Dict[str, Any]], mesh,
         it = itertools.islice(it, jax.process_index(), None,
                               jax.process_count())
     while True:
+        if watchdog is not None:
+            # every round below blocks in pod-wide collectives (all_status
+            # + the replicated-out_shardings gather): pet per round so a
+            # host lost mid-extraction dumps stacks instead of hanging
+            watchdog.pet()
         # status codes: 0 = drained, 1 = has data, 2 = error.  A host that
         # CANNOT continue — iterator raised (unreadable file), or an empty
         # shard with no shape template to pad from — must broadcast the
@@ -273,11 +288,18 @@ def fit_and_score(train_x: np.ndarray, train_y: np.ndarray,
 
 def linear_eval(apply_fn: Callable, train_batches: Iterator,
                 test_batches: Iterator, num_classes: int, *,
-                epochs: int = 30, lr: float = 0.1, seed: int = 0
-                ) -> LinearEvalResult:
+                epochs: int = 30, lr: float = 0.1, seed: int = 0,
+                watchdog: Optional[Any] = None) -> LinearEvalResult:
     """Full offline protocol: extract -> fit probe -> report top-1/5."""
-    train_x, train_y = extract_features(apply_fn, train_batches)
-    test_x, test_y = extract_features(apply_fn, test_batches)
+    train_x, train_y = extract_features(apply_fn, train_batches,
+                                        watchdog=watchdog)
+    test_x, test_y = extract_features(apply_fn, test_batches,
+                                      watchdog=watchdog)
+    if watchdog is not None:
+        # Extraction (the collective/readback windows the watchdog covers)
+        # is done; the probe fit below is minutes of HOST compute with no
+        # pet points — an armed deadline would kill a healthy run.
+        watchdog.stop()
     return fit_and_score(train_x, train_y, test_x, test_y, num_classes,
                          epochs=epochs, lr=lr, seed=seed)
 
@@ -299,7 +321,8 @@ def encoder_apply_fn(net, state, *, half: bool = False,
 
 
 def run_linear_eval_from_cfg(cfg, state, *, loader=None, mesh=None,
-                             epochs: int = 30, seed: int = 0
+                             epochs: int = 30, seed: int = 0,
+                             watchdog: Optional[Any] = None
                              ) -> LinearEvalResult:
     """Convenience driver: rebuild the encoder from ``cfg``, extract
     resize-only features for the train/test splits, fit + score the probe.
@@ -329,14 +352,14 @@ def run_linear_eval_from_cfg(cfg, state, *, loader=None, mesh=None,
                                     normalize=cfg.parity.normalize_inputs)
         return linear_eval(apply_fn, loader.train_eval_loader,
                            loader.test_loader, loader.output_size,
-                           epochs=epochs, seed=seed)
+                           epochs=epochs, seed=seed, watchdog=watchdog)
     host_batch = rcfg.global_batch_size // jax.process_count()
     apply_fn = encoder_extractor_spmd(net, state, mesh,
                                       half=cfg.device.half,
                                       normalize=cfg.parity.normalize_inputs)
     train_x, train_y = extract_features_spmd(
         apply_fn, loader.train_eval_loader, mesh, host_batch=host_batch,
-        sample_shape=loader.input_shape)
+        sample_shape=loader.input_shape, watchdog=watchdog)
     # Quirk Q9: with an unsharded test split every host iterates the FULL
     # test set — deal the batches round-robin so each sample is encoded
     # once.  The flag comes from how the LOADER was built (not the config),
@@ -344,7 +367,8 @@ def run_linear_eval_from_cfg(cfg, state, *, loader=None, mesh=None,
     eval_sharded = getattr(loader, "eval_sharded", cfg.device.shard_eval)
     test_x, test_y = extract_features_spmd(
         apply_fn, loader.test_loader, mesh, host_batch=host_batch,
-        replicated_data=not eval_sharded, sample_shape=loader.input_shape)
+        replicated_data=not eval_sharded, sample_shape=loader.input_shape,
+        watchdog=watchdog)
     # Sanity check (ADVICE r4): a caller-built bundle whose test iterator
     # IS per-host sharded but whose eval_sharded flag says replicated gets
     # round-robin dealing over genuinely different shards — silently
@@ -359,5 +383,8 @@ def run_linear_eval_from_cfg(cfg, state, *, loader=None, mesh=None,
             "test iterator is actually sharded (dealing over per-host "
             "shards drops samples; masking over replicated data "
             "double-counts none but gathers all)")
+    if watchdog is not None:
+        # same as linear_eval: disarm before the pet-free host probe fit
+        watchdog.stop()
     return fit_and_score(train_x, train_y, test_x, test_y,
                          loader.output_size, epochs=epochs, seed=seed)

@@ -77,6 +77,8 @@ from byol_tpu.core.precision import Policy, FP32
 from byol_tpu.data import device_augment
 from byol_tpu.objectives.byol_loss import loss_function
 from byol_tpu.objectives.metrics import cross_entropy, topk_accuracy
+from byol_tpu.observability import health as health_lib
+from byol_tpu.optim import lars as lars_lib
 from byol_tpu.optim.schedules import cosine_ema_decay
 from byol_tpu.training.state import TrainState
 
@@ -125,6 +127,28 @@ class StepConfig:
                                          # H); required when augment_in_step
     color_jitter_strength: float = 1.0   # augment strength (step placement)
     aug_seed: int = 0                    # base seed of the in-step key stream
+    telemetry: str = "off"               # --telemetry off|epoch|step: when
+                                         # not 'off', the train step packs
+                                         # the in-graph health vector
+                                         # (observability/health.py) into
+                                         # metrics['health'].  'off' traces
+                                         # the exact pre-telemetry graph
+                                         # (pinned by an HLO-identity test).
+    weight_decay: float = 0.0            # telemetry only: LARS folds wd
+                                         # into the gradient BEFORE the
+                                         # trust ratio (optim/lars.py step
+                                         # 1), so the health vector's trust
+                                         # stats must see g + wd*p too or
+                                         # they drift from what was applied
+    lars_in_chain: bool = True           # telemetry only: the optimizer
+                                         # chain contains the LARS wrapper
+                                         # (build.py: 'lars_' prefix).
+                                         # False packs identity (1.0) trust
+                                         # stats — no transform applied a
+                                         # ratio, and reporting a computed
+                                         # one as "applied" would be
+                                         # fiction (LAMB's internal ratio
+                                         # is not surfaced here)
 
 
 def _forward_views(net, params, batch_stats, aug1, aug2, *, train: bool,
@@ -211,6 +235,10 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
         raise ValueError(
             "augment_in_step requires image_size > 0 (the augment target "
             f"size), got {scfg.image_size}")
+    if scfg.telemetry not in ("off", "epoch", "step"):
+        raise ValueError(
+            f"unknown telemetry mode {scfg.telemetry!r}; "
+            "'off' | 'epoch' | 'step'")
 
     def micro_grads(params, target_params, batch_stats, view1, view2,
                     labels):
@@ -257,6 +285,18 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
 
         grads, (new_bs, metrics) = jax.grad(
             loss_fn, has_aux=True)(params)
+        if scfg.telemetry != "off":
+            # Collapse signature of the STOP-GRAD target projections,
+            # computed here (not after the update) because accumulation
+            # keeps only ONE microbatch's projections live — the per-
+            # microbatch scalars mean-accumulate through the scan like
+            # every other metric, and train_step pops them into the
+            # packed health vector.  The leading underscore keeps them
+            # out of the grapher's *_mean plotting filter by contract.
+            fstd, cosm = health_lib.collapse_stats(
+                jnp.concatenate([target_proj1, target_proj2], axis=0))
+            metrics = dict(metrics, _collapse_feature_std=fstd,
+                           _collapse_cosine_mean=cosm)
         return policy.cast_to_param(grads), new_bs, metrics
 
     def micro_views(xs):
@@ -374,6 +414,38 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
             new_polyak = jax.tree_util.tree_map(
                 lambda m, p: d * m + (1.0 - d) * p,
                 state.polyak_params, new_params)
+
+        if scfg.telemetry != "off":
+            # Pack the step's health diagnostics (observability/health.py)
+            # into ONE fp32 vector under metrics['health'] — a step OUTPUT
+            # (replicated out_sharding like every metric), read back
+            # asynchronously by the TelemetrySink with >= interval-step
+            # lag, so telemetry adds reductions to the graph but zero host
+            # syncs to the dispatch loop.  Trust ratios use the PRE-update
+            # params — what the LARS transform saw this step.
+            metrics = dict(metrics)
+            collapse = (metrics.pop("_collapse_feature_std"),
+                        metrics.pop("_collapse_cosine_mean"))
+            # The ratio LARS APPLIES is computed on the post-wd gradient:
+            # run the SAME fold-in transform the optimizer chain runs
+            # (lars_weight_decay — shared code, so the reported spread
+            # can never drift from the applied one).  Non-LARS chains
+            # applied no ratio: pack identity rather than a fictitious
+            # "applied" value.  Residual caveat: --clip > 0 clips before
+            # LARS and is not replicated (value clipping is off in every
+            # recipe this telemetry targets).
+            if scfg.lars_in_chain:
+                wd_tx = lars_lib.lars_weight_decay(scfg.weight_decay)
+                trust_grads, _ = wd_tx.update(
+                    grads, wd_tx.init(state.params), state.params)
+                trust = lars_lib.trust_ratio_vector(trust_grads,
+                                                    state.params)
+            else:
+                trust = jnp.ones((1,), jnp.float32)
+            metrics["health"] = health_lib.health_stats(
+                grads=grads, updates=updates, params=new_params,
+                target_params=new_target, loss=metrics["loss_mean"],
+                collapse=collapse, trust_ratios=trust)
 
         new_state = state.replace(
             step=state.step + 1,

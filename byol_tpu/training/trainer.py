@@ -38,7 +38,11 @@ from byol_tpu.data.loader import LoaderBundle, get_loader, pad_batch
 from byol_tpu.data.prefetch import prefetch_to_mesh
 from byol_tpu.observability import (Grapher, InputPipelineMeter,
                                     MetricAccumulator, StepTimer,
-                                    epoch_log_line, input_log_line)
+                                    epoch_log_line, input_log_line,
+                                    profiling)
+from byol_tpu.observability.events import RunLog
+from byol_tpu.observability.telemetry import NanHaltError, TelemetrySink
+from byol_tpu.observability.watchdog import Watchdog
 from byol_tpu.parallel.mesh import (MeshSpec, build_mesh, initialize_distributed,
                                     shard_batch_to_mesh)
 from byol_tpu.training.build import setup_training
@@ -143,6 +147,44 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         larger_is_better=False,
         max_early_stop_steps=10)
 
+    # Structured run log (observability/events.py): every fit produces a
+    # schema-versioned run.jsonl next to the grapher output — run header,
+    # interval health records, epoch/checkpoint/anomaly events — the same
+    # machine-readable format bench.py emits per row.  Rank-0 discipline
+    # like the grapher.
+    events: Optional[RunLog] = None
+    if jax.process_index() == 0:
+        # best_effort: an unopenable log_dir at startup or a disk filling
+        # mid-run disables the log with a warning — the observability layer
+        # must never kill the multi-hour training run it observes (same
+        # contract bench.py applies)
+        events = RunLog(os.path.join(cfg.task.log_dir, name, "run.jsonl"),
+                        best_effort=True)
+        events.emit(
+            "run_header", config=cfg.to_dict(), jax_version=jax.__version__,
+            backend=jax.default_backend(), run_name=name,
+            mesh_shape={str(k): int(v) for k, v in mesh.shape.items()},
+            n_devices=jax.device_count(),
+            steps_per_train_epoch=rcfg.steps_per_train_epoch,
+            global_batch_size=rcfg.global_batch_size)
+
+    # Telemetry sink: asynchronous (>= interval-step lag) readback of the
+    # in-graph health vector + anomaly rules.  Created on EVERY process so
+    # --nan-policy halt stops the whole pod, not just rank 0; only rank 0
+    # writes events.
+    sink: Optional[TelemetrySink] = None
+    telemetry_mode = cfg.device.telemetry
+    if telemetry_mode != "off":
+        sink = TelemetrySink(cfg.device.telemetry_interval,
+                             nan_policy=cfg.device.nan_policy,
+                             events=events, verbose=verbose)
+
+    # Hung-collective watchdog (§5.2): a lost host shows up as a readback
+    # that never returns — in the train-epoch readback, but equally in the
+    # eval loops, the linear-eval extraction, and the checkpoint flush.
+    # Created up-front so every blocking window below can pet it.
+    watchdog = Watchdog(cfg.device.watchdog_timeout)
+
     # Eval batches are padded to the fixed per-host batch so all of them
     # share one compiled executable and shard cleanly on the data axis.
     host_eval_batch = rcfg.global_batch_size // jax.process_count()
@@ -155,6 +197,11 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         return {"view1": z, "view2": z, "label": np.zeros((0,), np.int32)}
 
     def run_eval(state, batches=None) -> MetricAccumulator:
+        # The eval dispatch loop + its eventual readback are a blocking
+        # window on pods (eval_step collectives): pet the watchdog around
+        # it so a collective that wedges HERE is caught, not just one in
+        # the train-epoch readback.
+        watchdog.pet()
         acc = MetricAccumulator()
         src = loader.test_loader if batches is None else batches
         if jax.process_count() > 1:
@@ -163,12 +210,13 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
             # deadlocks in eval_step's collectives
             from byol_tpu.parallel.lockstep import lockstep_iter
             src = lockstep_iter(src, _all_pad_batch)
-        for batch in src:
-            dev_batch = shard_batch_to_mesh(
-                pad_batch(batch, host_eval_batch), mesh)
-            acc.update(eval_step(state, dev_batch))
-            if cfg.device.debug_step:
-                break
+        with profiling.annotate("byol/eval_dispatch"):
+            for batch in src:
+                dev_batch = shard_batch_to_mesh(
+                    pad_batch(batch, host_eval_batch), mesh)
+                acc.update(eval_step(state, dev_batch))
+                if cfg.device.debug_step:
+                    break
         return acc
 
     init_epoch = 0
@@ -179,9 +227,14 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         state, init_epoch = saver.restore(state, best=True)
         acc = run_eval(state)
         test_metrics = {k: float(v) for k, v in acc.result().items()}
+        watchdog.stop()
         if verbose:
             print(f"run already early-stopped at best epoch "
                   f"{init_epoch - 1}; nothing to train")
+        if events is not None:
+            events.emit("run_end", epoch=init_epoch - 1, stopped_early=True,
+                        already_stopped=True)
+            events.close()
         saver.close()
         grapher.close()
         return FitResult(state=state, epoch=init_epoch - 1, train_metrics={},
@@ -245,11 +298,26 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
               f"{int(state.step)}; exiting 143 for requeue")
         raise SystemExit(143)
 
-    # Hung-collective watchdog (§5.2): a lost host shows up as an epoch
-    # readback that never returns; dump stacks + die so the job requeues
-    # instead of hanging forever.
-    from byol_tpu.observability.watchdog import Watchdog
-    watchdog = Watchdog(cfg.device.watchdog_timeout)
+    # Host-side optimizer-step counter for the telemetry sink: int() on the
+    # INITIAL state is free (already materialized); per-step int(state.step)
+    # would be the host sync the whole telemetry design avoids.
+    global_step = int(state.step)
+
+    def _halt_dump(err: NanHaltError, epoch: int) -> None:
+        """--nan-policy halt tripped: dump step/state metadata to the run
+        log before the raise propagates (the post-mortem the operator
+        reads instead of a bare traceback)."""
+        if events is not None:
+            events.emit("state_dump", step=err.step, epoch=epoch,
+                        state_step=int(state.step),
+                        ema_step=int(state.ema_step),
+                        lr=float(schedule(int(state.step))),
+                        reason="nonfinite", health=err.record,
+                        run_name=name)
+            events.close()
+        watchdog.stop()
+        saver.close()
+        grapher.close()
 
     for epoch in range(init_epoch, cfg.task.epochs):
         # ---- train (execute_graph prefix='train', main.py:665-677) -------
@@ -307,40 +375,68 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         # the meter reports this epoch's H2D payload + starvation next to
         # the throughput numbers
         input_meter = InputPipelineMeter()
-        for dev_batch in prefetch_to_mesh(tapped_batches(), mesh,
-                                          meter=input_meter):
-            if not flops_resolved:
-                # Once per fit: FLOPs of the real train step via XLA cost
-                # analysis (observability/flops.py) -> MFU next to every
-                # throughput number.  Lowering only traces; must precede
-                # the first call because the step donates its input state.
-                flops_resolved = True
-                from byol_tpu.observability import flops as flops_lib
-                with mesh:
-                    step_flops = flops_lib.cost_analysis_flops(
-                        train_step, state, dev_batch)
-                if step_flops:
-                    timer.set_flops(step_flops / rcfg.global_batch_size,
-                                    flops_lib.chip_peak_tflops())
-            state, metrics = train_step(state, dev_batch)
-            acc.update(metrics)  # device-side running sum; no host sync
-            _maybe_preempt_save()
-            if cfg.device.fault_at_step and \
-                    int(state.step) == cfg.device.fault_at_step:
-                # fault injection (§5.3): die mid-epoch like a preempted pod
-                # worker; a relaunch must resume from the last checkpoint.
-                raise SystemExit(
-                    f"fault injected at step {int(state.step)} "
-                    f"(--fault-at-step)")
-            if cfg.device.debug_step:  # single-minibatch smoke (main.py:630)
-                break
-        train_metrics = {k: float(v) for k, v in acc.result().items()}
+        with profiling.annotate("byol/train_dispatch"):
+            for dev_batch in prefetch_to_mesh(tapped_batches(), mesh,
+                                              meter=input_meter):
+                if not flops_resolved:
+                    # Once per fit: FLOPs of the real train step via XLA
+                    # cost analysis (observability/flops.py) -> MFU next to
+                    # every throughput number.  Lowering only traces; must
+                    # precede the first call because the step donates its
+                    # input state.
+                    flops_resolved = True
+                    from byol_tpu.observability import flops as flops_lib
+                    with mesh:
+                        step_flops = flops_lib.cost_analysis_flops(
+                            train_step, state, dev_batch)
+                    if step_flops:
+                        timer.set_flops(step_flops / rcfg.global_batch_size,
+                                        flops_lib.chip_peak_tflops())
+                state, metrics = train_step(state, dev_batch)
+                global_step += 1
+                if sink is not None:
+                    # 'health' is the packed in-graph diagnostics vector —
+                    # popped so the scalar accumulator (and the epoch
+                    # float() conversions) only ever see scalars.  'step'
+                    # mode: lagged async readback; 'epoch' mode: hold the
+                    # newest, drained for free after the epoch readback.
+                    health_vec = metrics.pop("health")
+                    try:
+                        if telemetry_mode == "step":
+                            sink.offer(global_step, health_vec)
+                        else:
+                            sink.hold(global_step, health_vec)
+                    except NanHaltError as e:
+                        _halt_dump(e, epoch)
+                        raise
+                acc.update(metrics)  # device-side running sum; no host sync
+                _maybe_preempt_save()
+                if cfg.device.fault_at_step and \
+                        int(state.step) == cfg.device.fault_at_step:
+                    # fault injection (§5.3): die mid-epoch like a
+                    # preempted pod worker; a relaunch must resume from
+                    # the last checkpoint.
+                    raise SystemExit(
+                        f"fault injected at step {int(state.step)} "
+                        f"(--fault-at-step)")
+                if cfg.device.debug_step:  # single-minibatch smoke
+                    break                  # (main.py:630)
+        with profiling.annotate("byol/epoch_readback"):
+            train_metrics = {k: float(v) for k, v in acc.result().items()}
         # acc.result() is a D2H readback of sums depending on every step —
         # the only sync this platform can't fake, so the elapsed time (and
         # the throughput derived from it) is honest (StepTimer docstring).
         train_elapsed = time.time() - t0
         timer.record_epoch(acc.count, train_elapsed)
         watchdog.pet()  # readback returned: the collectives are alive
+        if sink is not None:
+            # epoch boundary: the readback above already synchronized, so
+            # draining the pending/held vectors costs nothing extra
+            try:
+                sink.drain()
+            except NanHaltError as e:
+                _halt_dump(e, epoch)
+                raise
         # the readback/eval/checkpoint windows dominate the epoch's
         # wall-clock — a preemption notice landing there must not wait for
         # the next epoch's batch loop (the grace period would expire first)
@@ -351,10 +447,19 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                                  train_elapsed, train_metrics))
             print(input_log_line(epoch, input_meter))
 
+        if events is not None:
+            events.emit("epoch", epoch=epoch, split="train",
+                        step=global_step, metrics=train_metrics,
+                        seconds=round(train_elapsed, 3),
+                        input_pipeline=input_meter.result(),
+                        images_per_sec_per_chip=(
+                            timer.images_per_sec_per_chip()))
+
         # ---- eval (prefix='test', main.py:680-692) -----------------------
         t0 = time.time()
         acc = run_eval(state)
         test_metrics = {k: float(v) for k, v in acc.result().items()}
+        watchdog.pet()  # eval readback returned
         _maybe_preempt_save()
         if verbose:
             # total_weight = exact valid rows (pad rows excluded)
@@ -364,6 +469,9 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                 int(n_eval) if n_eval is not None
                 else acc.count * rcfg.global_batch_size,
                 time.time() - t0, test_metrics))
+        if events is not None:
+            events.emit("epoch", epoch=epoch, split="test",
+                        step=global_step, metrics=test_metrics)
 
         # ---- valid split (num_valid_samples contract, main.py:421-423):
         # evaluated + logged per epoch; early stop still keys off TEST loss
@@ -380,6 +488,9 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                     else vacc.count * rcfg.global_batch_size,
                     time.time() - t0, valid_metrics))
             grapher.register_plots(valid_metrics, epoch, prefix="valid")
+            if events is not None:
+                events.emit("epoch", epoch=epoch, split="valid",
+                            step=global_step, metrics=valid_metrics)
 
         # ---- observability (main.py:646-657,764,773-779) -----------------
         grapher.register_plots(train_metrics, epoch, prefix="train")
@@ -410,7 +521,19 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         grapher.save()
 
         # ---- checkpoint + early stop (main.py:766-769) -------------------
-        if saver(test_metrics.get("loss_mean", float("inf")), epoch, state):
+        # The save serializes device state (a D2H readback window on pods):
+        # pet around it so a wedged collective during the flush is caught.
+        watchdog.pet()
+        with profiling.annotate("byol/checkpoint"):
+            stop_now = saver(test_metrics.get("loss_mean", float("inf")),
+                             epoch, state)
+        watchdog.pet()
+        if events is not None:
+            events.emit("checkpoint", epoch=epoch, step=global_step,
+                        metric=test_metrics.get("loss_mean"),
+                        best_metric=saver.best_metric,
+                        early_stop=bool(stop_now))
+        if stop_now:
             state, _ = saver.restore(state, best=True)
             acc = run_eval(state)
             test_metrics = {k: float(v) for k, v in acc.result().items()}
@@ -423,6 +546,12 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     watchdog.stop()
     if old_sigterm is not None:
         signal.signal(signal.SIGTERM, old_sigterm)
+    if events is not None:
+        events.emit(
+            "run_end", epoch=epoch, stopped_early=stopped,
+            images_per_sec_per_chip=timer.images_per_sec_per_chip(),
+            anomalies=(len(sink.anomalies) if sink is not None else 0))
+        events.close()
     saver.close()
     grapher.close()
     return FitResult(state=state, epoch=epoch, train_metrics=train_metrics,

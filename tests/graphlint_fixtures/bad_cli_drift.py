@@ -9,12 +9,25 @@ class DriftyCfg:
     momentum: float = 0.9        # no builder passes it: dead knob
 
 
+@dataclasses.dataclass(frozen=True)
+class DriftyTelemetryCfg:
+    """Telemetry-shaped GL106 case (ISSUE 6 corpus): the observability
+    knobs are exactly the kind that rot — a sink interval nothing can set
+    and a nan-policy flag nothing reads would silently un-observe a run."""
+
+    telemetry: str = "off"
+    telemetry_interval: int = 50   # no builder passes it: dead knob
+
+
 def build_parser():
     p = argparse.ArgumentParser()
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--drifty-ghost", type=int, default=0)  # never read
+    p.add_argument("--telemetry", type=str, default="off")
+    p.add_argument("--nan-ghost-policy", type=str, default="warn")  # unread
     return p
 
 
 def config_from_args(args):
-    return DriftyCfg(lr=args.lr)
+    return DriftyCfg(lr=args.lr), DriftyTelemetryCfg(
+        telemetry=args.telemetry)

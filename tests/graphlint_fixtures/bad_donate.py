@@ -13,3 +13,15 @@ def loop(state, batches):
     for batch in batches:
         new_state, metrics = train_step(state, batch)  # donates, no rebind
     return state                                       # reads a dead buffer
+
+
+def telemetry_loop(state, batches, sink):
+    """Telemetry-shaped GL104 case (ISSUE 6 corpus): offering the DONATED
+    state to the sink instead of the step's health OUTPUT — the packed
+    health vector is a fresh step output and never aliases the donated
+    buffer; reading the donated state back is the bug."""
+    for batch in batches:
+        new_state, metrics = train_step(state, batch)  # donates state
+        sink.offer(state)              # dead: state was donated above
+        state = new_state
+    return state

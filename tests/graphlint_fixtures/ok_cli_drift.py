@@ -9,12 +9,29 @@ class TidyCfg:
     momentum: float = 0.9
 
 
+@dataclasses.dataclass(frozen=True)
+class TidyTelemetryCfg:
+    """Telemetry-shaped near-miss (ISSUE 6 corpus): every observability
+    knob settable from a flag and every flag consumed — the wiring the
+    real --telemetry*/--nan-policy flags keep (and the tree gate pins)."""
+
+    telemetry: str = "off"
+    telemetry_interval: int = 50
+    nan_policy: str = "warn"
+
+
 def build_parser():
     p = argparse.ArgumentParser()
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--telemetry", type=str, default="off")
+    p.add_argument("--telemetry-interval", type=int, default=50)
+    p.add_argument("--nan-policy", type=str, default="warn")
     return p
 
 
 def config_from_args(args):
-    return TidyCfg(lr=args.lr, momentum=args.momentum)
+    return TidyCfg(lr=args.lr, momentum=args.momentum), TidyTelemetryCfg(
+        telemetry=args.telemetry,
+        telemetry_interval=args.telemetry_interval,
+        nan_policy=args.nan_policy)

@@ -14,3 +14,13 @@ def loop(state, batches):
     for batch in batches:
         state, metrics = train_step(state, batch)   # canonical rebind
     return state, metrics
+
+
+def telemetry_loop(state, batches, sink):
+    """Telemetry-shaped near-miss (ISSUE 6 corpus): the sink consumes the
+    step's health OUTPUT — a fresh array, never an alias of the donated
+    input state — and the state is rebound.  Must stay clean."""
+    for step, batch in enumerate(batches):
+        state, metrics = train_step(state, batch)   # rebind over donation
+        sink.offer(step, metrics["health"])         # output, not the input
+    return state

@@ -3,6 +3,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from byol_tpu.observability import (Grapher, MetricAccumulator, StepTimer,
                                     epoch_log_line, make_grid)
@@ -130,6 +131,49 @@ def test_metric_accumulator_weighted_by_valid_count():
     out = acc.result()
     assert out["top1_mean"] == 75.0          # (100*3 + 0*1) / 4
     assert "_weight" not in out
+
+
+class TestProfiling:
+    """observability/profiling.py CPU smoke — previously the only untested
+    observability module.  The trainer wraps its dispatch/readback phases in
+    ``annotate`` regions, so these pins are what keep captured traces
+    labeled."""
+
+    def test_trace_writes_profile_dir(self, tmp_path):
+        import jax.numpy as jnp
+        from byol_tpu.observability import profiling
+        with profiling.trace(str(tmp_path)):
+            (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+        # jax.profiler lays out <logdir>/plugins/profile/<ts>/*.xplane.pb
+        prof = tmp_path / "plugins" / "profile"
+        assert prof.is_dir()
+        captures = list(prof.iterdir())
+        assert captures, "trace() produced no capture directory"
+        assert any(f.suffix == ".pb" or f.name.endswith(".json.gz")
+                   for f in captures[0].iterdir())
+
+    def test_trace_stops_on_exception(self, tmp_path):
+        """The context manager must stop the trace on an exception so a
+        failed epoch does not leave the profiler running (a second
+        start_trace would raise)."""
+        from byol_tpu.observability import profiling
+        with pytest.raises(RuntimeError, match="boom"):
+            with profiling.trace(str(tmp_path / "a")):
+                raise RuntimeError("boom")
+        with profiling.trace(str(tmp_path / "b")):   # must not raise
+            pass
+
+    def test_annotate_nests_and_reenters(self):
+        import jax.numpy as jnp
+        from byol_tpu.observability import profiling
+        # nesting (the trainer's train_dispatch > step layout) and re-entry
+        # (one region per epoch) must both be clean, traced or not
+        with profiling.annotate("outer"):
+            with profiling.annotate("inner"):
+                (jnp.ones((2, 2)) + 1).block_until_ready()
+        for _ in range(2):
+            with profiling.annotate("outer"):
+                pass
 
 
 class TestFlopsAccounting:

@@ -1,0 +1,660 @@
+"""Telemetry layer: packed health vector, async sink, JSONL run log.
+
+Covers the ISSUE 6 acceptance surface:
+
+- packed-vector correctness vs a NumPy reference on tiny pytrees
+  (``health.health_stats`` + ``lars.trust_ratio_vector``);
+- ``--telemetry off`` lowers the exact pre-telemetry graph: the health
+  module is provably never traced (a raising stub), the metric pytree is
+  byte-for-byte the pre-PR key set, and the lowered HLO text is identical
+  across independent builds (and differs once telemetry is on);
+- async-lag readback under the ``guard_steps`` transfer guard — the sink's
+  explicit ``device_get`` never trips ``jax.transfer_guard("disallow")``
+  and every sample is read with >= interval-step lag;
+- the NaN-halt path via an injected non-finite gradient;
+- the JSONL event schema round-trip (``events.RunLog`` -> ``read_events``).
+"""
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byol_tpu.core import config as config_lib
+from byol_tpu.observability import events as events_lib
+from byol_tpu.observability import health
+from byol_tpu.observability.telemetry import NanHaltError, TelemetrySink
+from byol_tpu.optim import lars as lars_lib
+
+
+# ---------------------------------------------------------------------------
+# health.py vs NumPy reference
+# ---------------------------------------------------------------------------
+
+def _tiny_trees(seed=0, nan_in_grad=False):
+    rng = np.random.RandomState(seed)
+    params = {"w": rng.randn(3, 4).astype(np.float32),
+              "b": rng.randn(4).astype(np.float32)}
+    grads = {"w": rng.randn(3, 4).astype(np.float32),
+             "b": rng.randn(4).astype(np.float32)}
+    if nan_in_grad:
+        grads["w"][0, 0] = np.nan
+    updates = {"w": 0.1 * grads["w"], "b": 0.1 * grads["b"]}
+    target = {"w": 0.9 * params["w"], "b": 0.9 * params["b"]}
+    return params, grads, updates, target
+
+
+def _np_global_norm(tree):
+    return np.sqrt(sum(np.sum(np.square(v)) for v in tree.values()))
+
+
+class TestHealthVector:
+    def test_pack_unpack_roundtrip(self):
+        vals = {k: float(i + 1) for i, k in enumerate(health.HEALTH_FIELDS)}
+        vec = health.pack(vals)
+        assert vec.shape == (len(health.HEALTH_FIELDS),)
+        assert vec.dtype == jnp.float32
+        out = health.unpack(np.asarray(vec))
+        assert out == pytest.approx(vals)
+
+    def test_pack_rejects_field_drift(self):
+        vals = {k: 0.0 for k in health.HEALTH_FIELDS}
+        with pytest.raises(ValueError, match="extra"):
+            health.pack({**vals, "extra": 1.0})
+        vals.pop("loss")
+        with pytest.raises(ValueError, match="missing"):
+            health.pack(vals)
+
+    def test_health_stats_matches_numpy_reference(self):
+        params, grads, updates, target = _tiny_trees()
+        proj = np.random.RandomState(1).randn(8, 5).astype(np.float32)
+        collapse = health.collapse_stats(jnp.asarray(proj))
+        vec = health.health_stats(
+            grads=grads, updates=updates, params=params,
+            target_params=target, loss=jnp.float32(1.5),
+            collapse=collapse,
+            trust_ratios=lars_lib.trust_ratio_vector(grads, params))
+        d = health.unpack(np.asarray(vec))
+
+        assert d["grad_norm"] == pytest.approx(_np_global_norm(grads),
+                                               rel=1e-5)
+        assert d["update_norm"] == pytest.approx(
+            0.1 * _np_global_norm(grads), rel=1e-5)
+        assert d["param_norm"] == pytest.approx(_np_global_norm(params),
+                                                rel=1e-5)
+        drift = np.sqrt(sum(np.sum((params[k] - target[k]) ** 2)
+                            for k in params))
+        assert d["ema_drift"] == pytest.approx(drift, rel=1e-5)
+        assert d["ema_drift_rel"] == pytest.approx(
+            drift / _np_global_norm(params), rel=1e-5)
+        # only 'w' (ndim 2) is LARS-adapted -> min == median == max
+        ref_trust = 1e-3 * np.linalg.norm(params["w"]) / \
+            np.linalg.norm(grads["w"])
+        for k in ("trust_min", "trust_median", "trust_max"):
+            assert d[k] == pytest.approx(ref_trust, rel=1e-5)
+        # collapse reference: brute-force per-feature std + pairwise cosine
+        assert d["collapse_feature_std"] == pytest.approx(
+            np.mean(np.std(proj, axis=0)), rel=1e-4)
+        u = proj / np.linalg.norm(proj, axis=1, keepdims=True)
+        cos = [float(u[i] @ u[j]) for i in range(8) for j in range(8)
+               if i != j]
+        assert d["collapse_cosine_mean"] == pytest.approx(np.mean(cos),
+                                                          abs=1e-5)
+        assert d["nonfinite_count"] == 0.0
+        assert d["loss"] == 1.5
+
+    def test_nonfinite_count_sees_injected_nan(self):
+        params, grads, updates, target = _tiny_trees(nan_in_grad=True)
+        vec = health.health_stats(
+            grads=grads, updates=updates, params=params,
+            target_params=target, loss=jnp.float32(1.0),
+            collapse=(jnp.float32(1.0), jnp.float32(0.0)),
+            trust_ratios=jnp.ones((1,), jnp.float32))
+        assert health.unpack(np.asarray(vec))["nonfinite_count"] == 1.0
+
+    def test_collapsed_projections_signature(self):
+        # every row identical = fully collapsed: std -> 0, cosine -> 1
+        proj = jnp.tile(jnp.asarray([[1.0, 2.0, 3.0]]), (16, 1))
+        fstd, cosm = health.collapse_stats(proj)
+        assert float(fstd) == pytest.approx(0.0, abs=1e-6)
+        assert float(cosm) == pytest.approx(1.0, abs=1e-5)
+
+
+class TestLarsTrustStats:
+    def test_vector_matches_applied_transform(self):
+        """trust_ratio_vector reports exactly the ratio the optimizer
+        multiplies in (shared _leaf_trust_ratio implementation)."""
+        params, grads, _, _ = _tiny_trees(seed=3)
+        tx = lars_lib.scale_by_lars_trust_ratio()
+        scaled, _ = tx.update(grads, tx.init(params), params)
+        ratios = np.asarray(lars_lib.trust_ratio_vector(grads, params))
+        assert ratios.shape == (1,)              # only 'w' adapted
+        np.testing.assert_allclose(np.asarray(scaled["w"]),
+                                   grads["w"] * ratios[0], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(scaled["b"]), grads["b"])
+
+    def test_all_1d_tree_returns_identity(self):
+        ratios = lars_lib.trust_ratio_vector(
+            {"b": jnp.ones((3,))}, {"b": jnp.ones((3,))})
+        np.testing.assert_allclose(np.asarray(ratios), [1.0])
+
+    def test_wd_folded_ratio_matches_lars_chain(self):
+        """LARS folds weight decay into the gradient BEFORE the trust
+        ratio; the stats must be computed on the same post-wd gradient
+        (the fold-in steps.py replicates) to match what was applied."""
+        params, grads, _, _ = _tiny_trees(seed=5)
+        wd = 0.1
+        chain = optax_chain_wd_trust(wd)
+        applied, _ = chain.update(grads, chain.init(params), params)
+        g_wd = {"w": grads["w"] + wd * params["w"], "b": grads["b"]}
+        ratio = float(np.asarray(
+            lars_lib.trust_ratio_vector(g_wd, params))[0])
+        np.testing.assert_allclose(np.asarray(applied["w"]),
+                                   g_wd["w"] * ratio, rtol=1e-6)
+        # raw-gradient ratio would be wrong at this wd
+        raw = float(np.asarray(
+            lars_lib.trust_ratio_vector(grads, params))[0])
+        assert abs(raw - ratio) / ratio > 1e-3
+
+
+def optax_chain_wd_trust(wd):
+    import optax
+    return optax.chain(lars_lib.lars_weight_decay(wd),
+                       lars_lib.scale_by_lars_trust_ratio())
+
+
+def test_lars_in_chain_predicate_is_the_factory_one():
+    """StepConfig.lars_in_chain must use the factory's own is-LARS
+    normalization — a drifted copy (e.g. no .strip()) would pack identity
+    trust ratios for a run where LARS is actually scaling updates."""
+    from byol_tpu.optim.factory import is_lars_optimizer
+    assert is_lars_optimizer("lars_momentum")
+    assert is_lars_optimizer("  LARS_momentum  ")   # factory-normalized form
+    assert not is_lars_optimizer("momentum")
+    assert not is_lars_optimizer("lamb")
+
+
+# ---------------------------------------------------------------------------
+# in-step telemetry on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+def _step_rcfg(telemetry="off"):
+    c = config_lib.Config()
+    c = c.replace(
+        task=dataclasses.replace(c.task, batch_size=8, epochs=2),
+        model=dataclasses.replace(c.model, arch="resnet18",
+                                  head_latent_size=32, projection_size=16),
+        optim=dataclasses.replace(c.optim, warmup=1, lr=0.1),
+        device=dataclasses.replace(c.device, num_replicas=8, half=False,
+                                   telemetry=telemetry),
+    )
+    return config_lib.resolve(c, num_train_samples=64, num_test_samples=16,
+                              output_size=10, input_shape=(16, 16, 3),
+                              representation_size=512)
+
+
+def test_halt_policy_requires_telemetry():
+    """--nan-policy halt with --telemetry off would silently enforce
+    nothing (the sink only exists when telemetry is on): resolve() must
+    reject the combination."""
+    c = config_lib.Config()
+    c = c.replace(device=dataclasses.replace(
+        c.device, num_replicas=8, telemetry="off", nan_policy="halt"))
+    with pytest.raises(ValueError, match="halt requires"):
+        config_lib.resolve(c, num_train_samples=64, num_test_samples=16,
+                           output_size=10, input_shape=(16, 16, 3))
+
+
+def _make_batch(rcfg, seed=0, nan_at=None):
+    rng = np.random.RandomState(seed)
+    b = rcfg.global_batch_size
+    h, w, c = rcfg.input_shape
+    batch = {"view1": rng.rand(b, h, w, c).astype(np.float32),
+             "view2": rng.rand(b, h, w, c).astype(np.float32),
+             "label": rng.randint(0, rcfg.output_size, size=(b,))}
+    if nan_at is not None:
+        batch["view1"][nan_at] = np.nan
+    return batch
+
+
+def _lowered_text(rcfg, mesh):
+    from byol_tpu.training.build import setup_training
+    from byol_tpu.parallel.mesh import shard_batch_to_mesh
+    net, state, train_step, _, _ = setup_training(rcfg, mesh,
+                                                  jax.random.PRNGKey(0))
+    batch = shard_batch_to_mesh(_make_batch(rcfg), mesh)
+    with mesh:
+        lowered = train_step.__wrapped__.lower(state, batch)
+    return lowered.as_text()
+
+
+class TestStepTelemetry:
+    @pytest.fixture(scope="class")
+    def telemetry_training(self, mesh8, step_guard):
+        from byol_tpu.training.build import setup_training
+        rcfg = _step_rcfg(telemetry="step")
+        net, state, train_step, eval_step, _ = setup_training(
+            rcfg, mesh8, jax.random.PRNGKey(0))
+        return rcfg, state, step_guard(train_step)
+
+    def test_health_in_metrics_and_finite(self, telemetry_training, mesh8):
+        from byol_tpu.parallel.mesh import shard_batch_to_mesh
+        rcfg, state, train_step = telemetry_training
+        state = jax.tree_util.tree_map(jnp.copy, state)
+        batch = shard_batch_to_mesh(_make_batch(rcfg), mesh8)
+        state, metrics = train_step(state, batch)
+        assert "health" in metrics
+        d = health.unpack(np.asarray(jax.device_get(metrics["health"])))
+        assert all(np.isfinite(v) for v in d.values()), d
+        assert d["nonfinite_count"] == 0.0
+        assert d["grad_norm"] > 0 and d["param_norm"] > 0
+        assert 0 < d["trust_min"] <= d["trust_median"] <= d["trust_max"]
+        assert d["collapse_feature_std"] > 0
+        assert d["loss"] == pytest.approx(float(metrics["loss_mean"]),
+                                          rel=1e-5)
+
+    def test_injected_nan_halts_under_halt_policy(self, telemetry_training,
+                                                  mesh8, tmp_path):
+        """An injected non-finite input NaNs the gradients; the sink's
+        readback must record the anomaly and raise under nan_policy=halt,
+        with the anomaly + halt events in the run log."""
+        from byol_tpu.parallel.mesh import shard_batch_to_mesh
+        rcfg, state, train_step = telemetry_training
+        state = jax.tree_util.tree_map(jnp.copy, state)
+        batch = shard_batch_to_mesh(_make_batch(rcfg, nan_at=0), mesh8)
+        state, metrics = train_step(state, batch)
+        log = events_lib.RunLog(str(tmp_path / "run.jsonl"))
+        sink = TelemetrySink(1, nan_policy="halt", events=log,
+                             verbose=False)
+        with pytest.raises(NanHaltError) as err:
+            sink.offer(1, metrics["health"])
+            sink.drain()
+        assert err.value.record["nonfinite_count"] > 0
+        log.close()
+        kinds = [e["kind"] for e in
+                 events_lib.read_events(str(tmp_path / "run.jsonl"))]
+        assert "anomaly" in kinds and "halt" in kinds
+
+    def test_off_never_traces_health(self, mesh8, monkeypatch):
+        """--telemetry off is not 'health computed and discarded': the
+        health module is never even CALLED during trace, so the lowered
+        graph cannot contain its ops — 'identical HLO as before the PR'
+        by construction."""
+        def boom(**kw):
+            raise AssertionError("health_stats traced under telemetry=off")
+        monkeypatch.setattr(health, "health_stats", boom)
+        text = _lowered_text(_step_rcfg(telemetry="off"), mesh8)
+        assert text  # lowering succeeded without touching health_stats
+
+    def test_off_metric_keys_are_pre_pr_contract(self, mesh8):
+        from byol_tpu.training.build import setup_training
+        rcfg = _step_rcfg(telemetry="off")
+        net, state, train_step, _, _ = setup_training(
+            rcfg, mesh8, jax.random.PRNGKey(0))
+        from byol_tpu.parallel.mesh import shard_batch_to_mesh
+        batch = shard_batch_to_mesh(_make_batch(rcfg), mesh8)
+        with mesh8:
+            _, m_shape = jax.eval_shape(train_step.__wrapped__, state,
+                                        batch)
+        assert set(m_shape) == {"loss_mean", "byol_loss_mean",
+                                "linear_loss_mean", "top1_mean",
+                                "top5_mean"}
+
+    @pytest.mark.slow
+    def test_off_lowering_identical_step_differs(self, mesh8):
+        """The lowered-text pin: two independent telemetry-off builds
+        produce byte-identical HLO (the off path adds nothing and is
+        deterministic), while telemetry=step produces a different
+        program (the gate is live)."""
+        off1 = _lowered_text(_step_rcfg(telemetry="off"), mesh8)
+        off2 = _lowered_text(_step_rcfg(telemetry="off"), mesh8)
+        assert off1 == off2
+        step = _lowered_text(_step_rcfg(telemetry="step"), mesh8)
+        assert step != off1
+
+
+# ---------------------------------------------------------------------------
+# TelemetrySink: lag, guard-compat, anomaly rules
+# ---------------------------------------------------------------------------
+
+def _vec(**overrides):
+    vals = {"grad_norm": 1.0, "update_norm": 0.1, "param_norm": 10.0,
+            "ema_drift": 0.5, "ema_drift_rel": 0.05, "trust_min": 1e-3,
+            "trust_median": 1e-3, "trust_max": 2e-3,
+            "collapse_feature_std": 0.5, "collapse_cosine_mean": 0.1,
+            "nonfinite_count": 0.0, "loss": 2.0}
+    vals.update(overrides)
+    return health.pack(vals)
+
+
+class TestTelemetrySink:
+    def test_lagged_readback_under_transfer_guard(self):
+        """Samples are read back only once a NEWER sample exists (>= one
+        interval of dispatch in between), and the explicit device_get
+        stays legal under jax.transfer_guard('disallow') — the same guard
+        the jitted steps run under in tests (guard_steps)."""
+        sink = TelemetrySink(2, verbose=False)
+        # vectors land on device OUTSIDE the guard (in real use they are
+        # step outputs, already device-resident); the guard covers the
+        # sink's readbacks — the part that runs in the dispatch loop
+        v1, v2, v4 = _vec(), _vec(loss=2.0), _vec(loss=1.5)
+        with jax.transfer_guard("disallow"):
+            assert sink.offer(1, v1) == []          # off-interval: ignored
+            assert sink.offer(2, v2) == []
+            assert list(sink.records) == []         # newest stays pending
+            sink.offer(4, v4)
+        assert [r["step"] for r in sink.records] == [2.0]
+        assert sink.records[0]["loss"] == 2.0
+        sink.drain()
+        assert [r["step"] for r in sink.records] == [2.0, 4.0]
+
+    def test_epoch_mode_hold_keeps_only_latest(self):
+        sink = TelemetrySink(1, verbose=False)
+        sink.hold(1, _vec(loss=3.0))
+        sink.hold(2, _vec(loss=2.5))
+        assert len(sink.records) == 0
+        sink.drain()
+        assert [r["step"] for r in sink.records] == [2.0]
+
+    def test_nan_warn_records_anomaly_without_raising(self):
+        sink = TelemetrySink(1, nan_policy="warn", verbose=False)
+        sink.offer(1, _vec(nonfinite_count=3.0))
+        anomalies = sink.drain()
+        assert [a["rule"] for a in anomalies] == ["nonfinite"]
+        assert sink.anomalies and not sink.records[-1].get("halted")
+
+    def test_nan_halt_raises(self):
+        sink = TelemetrySink(1, nan_policy="halt", verbose=False)
+        sink.offer(1, _vec(nonfinite_count=1.0))
+        with pytest.raises(NanHaltError):
+            sink.drain()
+
+    def test_collapse_rule(self):
+        sink = TelemetrySink(1, verbose=False)
+        sink.offer(1, _vec(collapse_feature_std=1e-6,
+                           collapse_cosine_mean=0.9999))
+        anomalies = sink.drain()
+        assert [a["rule"] for a in anomalies] == ["collapse"]
+
+    def test_step_time_spike_rule(self):
+        """Six steady samples then one 10x-slower interval must trip the
+        spike rule (ring median comparison on dispatch timestamps)."""
+        sink = TelemetrySink(1, verbose=False)
+        walls = [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 16.0]
+        anomalies = []
+        for i, w in enumerate(walls):
+            anomalies += sink.offer(i + 1, _vec(), wall=w)
+        anomalies += sink.drain()
+        assert [a["rule"] for a in anomalies] == ["step_time_spike"]
+        assert anomalies[0]["step"] == 8
+
+    def test_epoch_boundary_gap_is_not_a_spike(self):
+        """drain() (the epoch boundary) invalidates the timebase: the gap
+        to the next epoch's first sample spans eval/checkpoint wall time
+        and must not fire step_time_spike."""
+        sink = TelemetrySink(1, verbose=False)
+        anomalies = []
+        for i, w in enumerate([0.0, 1.0, 2.0, 3.0, 4.0, 5.0]):
+            anomalies += sink.offer(i + 1, _vec(), wall=w)
+        anomalies += sink.drain()             # epoch boundary
+        # next epoch starts 100s later (eval + checkpoint happened)
+        anomalies += sink.offer(7, _vec(), wall=105.0)
+        anomalies += sink.offer(8, _vec(), wall=106.0)
+        anomalies += sink.drain()
+        assert anomalies == []
+        # the boundary-straddling sample carries no sec_per_step at all
+        rec7 = next(r for r in sink.records if r["step"] == 7.0)
+        assert "sec_per_step" not in rec7
+
+    def test_validates_ctor_args(self):
+        with pytest.raises(ValueError):
+            TelemetrySink(0)
+        with pytest.raises(ValueError):
+            TelemetrySink(1, nan_policy="explode")
+
+
+# ---------------------------------------------------------------------------
+# events.py: schema round-trip
+# ---------------------------------------------------------------------------
+
+class TestRunLog:
+    def test_roundtrip_all_kinds(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        with events_lib.RunLog(p) as log:
+            log.emit("run_header", config={"a": 1}, jax_version="0",
+                     backend="cpu")
+            log.emit("step", step=50,
+                     health={k: 0.0 for k in health.HEALTH_FIELDS})
+            log.emit("epoch", epoch=0, split="train",
+                     metrics={"loss_mean": 1.0},
+                     input_pipeline={"h2d_bytes_per_step": 1.0})
+            log.emit("anomaly", step=50, rule="collapse", detail="x")
+            log.emit("checkpoint", epoch=0, best_metric=1.0)
+            log.emit("run_end", epoch=0)
+        got = list(events_lib.read_events(p))
+        assert [e["kind"] for e in got] == [
+            "run_header", "step", "epoch", "anomaly", "checkpoint",
+            "run_end"]
+        assert all(e["v"] == events_lib.SCHEMA_VERSION for e in got)
+        assert got[1]["health"]["loss"] == 0.0
+
+    def test_emit_validates_kind_and_required_fields(self, tmp_path):
+        log = events_lib.RunLog(str(tmp_path / "r.jsonl"))
+        with pytest.raises(ValueError, match="unknown event kind"):
+            log.emit("not_a_kind", x=1)
+        with pytest.raises(ValueError, match="missing required"):
+            log.emit("epoch", epoch=0, split="train")  # no metrics
+        log.close()
+
+    def test_reader_rejects_corrupt_and_drifted_lines(self, tmp_path):
+        p = tmp_path / "r.jsonl"
+        with events_lib.RunLog(str(p)) as log:
+            log.emit("run_end")
+        with open(p, "a") as f:
+            f.write("{not json\n")
+        with pytest.raises(ValueError, match=":2:"):
+            list(events_lib.read_events(str(p)))
+        p2 = tmp_path / "r2.jsonl"
+        p2.write_text(json.dumps({"v": 999, "kind": "run_end",
+                                  "t": 0.0}) + "\n")
+        with pytest.raises(ValueError, match="schema version"):
+            list(events_lib.read_events(str(p2)))
+
+    def test_numpy_payloads_serialize(self, tmp_path):
+        p = str(tmp_path / "r.jsonl")
+        with events_lib.RunLog(p) as log:
+            log.emit("epoch", epoch=np.int64(3), split="train",
+                     metrics={"loss_mean": np.float32(1.5),
+                              "vec": np.arange(3)})
+        (e,) = events_lib.read_events(p)
+        assert e["epoch"] == 3 and e["metrics"]["vec"] == [0, 1, 2]
+
+    def test_nonfinite_floats_emit_strict_json(self, tmp_path):
+        """The lines a NaN run produces are exactly the ones machine
+        consumers must be able to read: Python's lenient writer would emit
+        bare ``NaN``/``Infinity`` tokens (invalid JSON for jq/JS/serde) —
+        the log maps non-finite floats to strings instead."""
+        p = str(tmp_path / "r.jsonl")
+        health_vals = {k: 0.0 for k in health.HEALTH_FIELDS}
+        health_vals["loss"] = float("nan")
+        health_vals["grad_norm"] = float("inf")
+        health_vals["trust_min"] = np.float32("-inf")
+        with events_lib.RunLog(p) as log:
+            log.emit("step", step=50, health=health_vals,
+                     extra=np.array([1.0, np.nan]))
+        with open(p) as f:
+            (line,) = f.read().splitlines()
+        # strict parse: reject any bare non-finite constant token
+        e = json.loads(line, parse_constant=lambda tok: pytest.fail(
+            f"bare {tok} token in run-log line: not strict JSON"))
+        assert e["health"]["loss"] == "NaN"
+        assert e["health"]["grad_norm"] == "Infinity"
+        assert e["health"]["trust_min"] == "-Infinity"
+        assert e["health"]["update_norm"] == 0.0     # finite stays a float
+        assert e["extra"] == [1.0, "NaN"]            # arrays sanitized too
+
+    def test_best_effort_write_failure_disables_not_raises(self, tmp_path):
+        """Observability must not kill the run it observes: with
+        best_effort, an OSError on write (disk full, quota, ro fs)
+        disables the log with a warning and later emits become no-ops;
+        without best_effort the error propagates."""
+        class _FullDisk:
+            def write(self, s):
+                raise OSError(28, "No space left on device")
+
+            def close(self):
+                pass
+
+            closed = False
+
+        p = str(tmp_path / "r.jsonl")
+        log = events_lib.RunLog(p, best_effort=True)
+        log.emit("run_end")
+        log._f.close()
+        log._f = _FullDisk()               # the fs goes away mid-run
+        log.emit("run_end", epoch=1)       # must not raise
+        assert log.disabled
+        log.emit("run_end", epoch=2)       # disabled: no-op, no raise
+        log.flush(); log.close()           # all no-ops once disabled
+        assert [e["kind"] for e in events_lib.read_events(p)] == ["run_end"]
+        # schema violations still raise even in best-effort mode
+        with pytest.raises(ValueError):
+            log.emit("not_a_kind")
+        strict = events_lib.RunLog(p)
+        strict._f = _FullDisk()
+        with pytest.raises(OSError):       # default: propagate
+            strict.emit("run_end")
+
+    def test_best_effort_ctor_failure_disables_not_raises(self, tmp_path):
+        """best_effort covers CONSTRUCTION too (an unopenable log_dir at
+        startup), so trainer.fit and bench.py get the never-kill-the-run
+        contract from RunLog itself instead of hand-rolled wrappers."""
+        blocker = tmp_path / "blocker"
+        blocker.write_text("not a dir")
+        p = str(blocker / "run.jsonl")    # parent is a FILE: makedirs raises
+        with pytest.raises(OSError):
+            events_lib.RunLog(p)
+        log = events_lib.RunLog(p, best_effort=True)
+        assert log.disabled
+        log.emit("run_end")               # no-op, must not raise
+        log.flush()
+        log.close()
+
+    def test_lines_are_crash_safe_before_close(self, tmp_path):
+        """Line-buffered append: every emitted event is durable on its own
+        newline — a crash loses at most the in-flight line."""
+        p = str(tmp_path / "r.jsonl")
+        log = events_lib.RunLog(p)
+        log.emit("run_end")
+        # read WITHOUT close/flush: the line must already be on disk
+        assert [e["kind"] for e in events_lib.read_events(p)] == ["run_end"]
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# fit() integration: run.jsonl + halt
+# ---------------------------------------------------------------------------
+
+def _fit_cfg(tmp_path, **device_over):
+    from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
+                                      OptimConfig, TaskConfig)
+    return Config(
+        task=TaskConfig(task="fake", batch_size=16, epochs=2,
+                        image_size_override=16,
+                        log_dir=str(tmp_path / "runs")),
+        model=ModelConfig(arch="resnet18", head_latent_size=32,
+                          projection_size=16,
+                          model_dir=str(tmp_path / "models")),
+        optim=OptimConfig(lr=0.05, warmup=1, optimizer="lars_momentum"),
+        device=DeviceConfig(num_replicas=8, half=False, seed=7,
+                            debug_step=True, **device_over),
+    )
+
+
+@pytest.mark.slow
+class TestFitRunLog:
+    def _run_log(self, cfg):
+        import os
+        from byol_tpu.core.config import run_name
+        return os.path.join(cfg.task.log_dir, run_name(cfg), "run.jsonl")
+
+    def test_fit_emits_valid_run_log(self, tmp_path):
+        from byol_tpu.data.loader import get_loader
+        from byol_tpu.observability import Grapher
+        from byol_tpu.training.trainer import fit
+        cfg = _fit_cfg(tmp_path, telemetry="step", telemetry_interval=1)
+        loader = get_loader(cfg, num_fake_samples=32)
+        grapher = Grapher("jsonl", logdir=str(tmp_path / "runs"),
+                          run_name="g", enabled=True)
+        fit(cfg, loader=loader, grapher=grapher, verbose=False)
+        got = list(events_lib.read_events(self._run_log(cfg)))
+        kinds = [e["kind"] for e in got]
+        assert kinds[0] == "run_header" and kinds[-1] == "run_end"
+        assert {"step", "epoch", "checkpoint"} <= set(kinds)
+        header = got[0]
+        assert header["config"]["device"]["telemetry"] == "step"
+        assert header["mesh_shape"].get("data") == 8
+        steps = [e for e in got if e["kind"] == "step"]
+        assert steps and all(set(health.HEALTH_FIELDS)
+                             <= set(e["health"]) for e in steps)
+        epochs = [e for e in got if e["kind"] == "epoch"]
+        assert {e["split"] for e in epochs} == {"train", "test"}
+        train_ep = next(e for e in epochs if e["split"] == "train")
+        assert "input_pipeline" in train_ep
+        assert "loss_mean" in train_ep["metrics"]
+
+    def test_fit_survives_unopenable_run_log(self, tmp_path):
+        """RunLog's best_effort only guards WRITES; the constructor's
+        makedirs/open can raise at startup (quota, read-only fs) and must
+        degrade to events=None instead of killing the run — same contract
+        bench.py's _open_events applies."""
+        from byol_tpu.data.loader import get_loader
+        from byol_tpu.observability import Grapher
+        from byol_tpu.training.trainer import fit
+        cfg = _fit_cfg(tmp_path, telemetry="step", telemetry_interval=1)
+        # run_name(cfg)'s parent component is a FILE: makedirs in
+        # RunLog.__init__ raises (FileExistsError/NotADirectoryError,
+        # both OSError)
+        (tmp_path / "runs").mkdir()
+        from byol_tpu.core.config import run_name
+        (tmp_path / "runs" / run_name(cfg)).write_text("not a dir")
+        loader = get_loader(cfg, num_fake_samples=32)
+        grapher = Grapher("jsonl", logdir=str(tmp_path / "runs_g"),
+                          run_name="g3", enabled=True)
+        result = fit(cfg, loader=loader, grapher=grapher, verbose=False)
+        assert result.epoch >= 0   # trained to completion, log disabled
+
+    def test_fit_halts_on_injected_nan_with_state_dump(self, tmp_path):
+        """A NaN smuggled into the train views must halt the run under
+        --nan-policy halt and leave anomaly + halt + state_dump events in
+        the run log — the acceptance-criteria drill."""
+        from byol_tpu.data.loader import get_loader
+        from byol_tpu.observability import Grapher
+        from byol_tpu.training.trainer import fit
+        cfg = _fit_cfg(tmp_path, telemetry="step", telemetry_interval=1,
+                       nan_policy="halt")
+        loader = get_loader(cfg, num_fake_samples=32)
+
+        def nan_train_iter(epoch, _base=loader.make_train_iter):
+            for batch in _base(epoch):
+                batch = dict(batch)
+                v = np.array(batch["view1"])
+                v[0, 0, 0, 0] = np.nan   # passes the [0,1] range check
+                batch["view1"] = v
+                yield batch
+
+        loader = dataclasses.replace(loader,
+                                     make_train_iter=nan_train_iter)
+        grapher = Grapher("jsonl", logdir=str(tmp_path / "runs"),
+                          run_name="g2", enabled=True)
+        with pytest.raises(NanHaltError):
+            fit(cfg, loader=loader, grapher=grapher, verbose=False)
+        got = list(events_lib.read_events(self._run_log(cfg)))
+        kinds = [e["kind"] for e in got]
+        assert "anomaly" in kinds and "halt" in kinds
+        dump = next(e for e in got if e["kind"] == "state_dump")
+        assert dump["reason"] == "nonfinite"
+        assert dump["health"]["nonfinite_count"] > 0
+        assert "state_step" in dump and "lr" in dump
