@@ -67,6 +67,16 @@ Usage:
                                    #   full observation cost (in-graph
                                    #   health vector + lagged sink
                                    #   readback); budget < 2%
+  python bench.py --zero1-ab       # ZeRO-1 weight-update-sharding A/B
+                                   #   (--dry-compile flavored: AOT compile
+                                   #   only, no execution): replicated vs
+                                   #   --zero1 on at the accumulation
+                                   #   target config; every row records
+                                   #   hbm_high_water_bytes + the per-chip
+                                   #   optimizer_state_bytes column (which
+                                   #   must scale ~1/N with mesh size).
+                                   #   --cpu-devices N sizes the virtual
+                                   #   CPU mesh for off-hardware captures
 
 Every run also appends structured events (run header + one ``bench_row``
 per measured config) to ``bench_events.jsonl`` — the same schema-versioned
@@ -165,7 +175,8 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
            stem: str = "conv", attn_impl: str = "dense",
            accum_steps: int = 1, accum_bn_mode: str = "average",
            remat_policy: str = "none", augment_placement: str = "loader",
-           telemetry: str = "off", materialize_batch: bool = True):
+           telemetry: str = "off", zero1: str = "off",
+           materialize_batch: bool = True):
     from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
                                       OptimConfig, ParityConfig, TaskConfig,
                                       resolve)
@@ -184,7 +195,7 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
         optim=OptimConfig(accum_steps=accum_steps,
                           accum_bn_mode=accum_bn_mode),
         device=DeviceConfig(num_replicas=n_dev, half=half, seed=0,
-                            telemetry=telemetry),
+                            telemetry=telemetry, zero1=zero1),
         parity=ParityConfig(ema_update_mode=ema_update_mode),
     )
     rcfg = resolve(cfg, num_train_samples=1_281_167, num_test_samples=50_000,
@@ -233,6 +244,31 @@ def _batch_h2d_bytes(batch) -> int:
     return host_nbytes(batch)
 
 
+def _optimizer_state_bytes(state) -> int | None:
+    """PER-CHIP bytes of the weight-update state (optimizer state + EMA
+    target): the HBM the ZeRO-1 A/B exists to measure.  Computed from each
+    leaf's SHARDING (``shard_shape``), not its global shape — a flat
+    leaf-partitioned tree reports ~1/N of its replicated size, which is
+    exactly the per-chip truth ``memory_analysis()``'s aggregate argument
+    bytes cannot break out.  Best-effort: states without shardings (or
+    non-TrainState pytrees) yield None rather than failing the rung."""
+    import math
+    try:
+        leaves = jax.tree_util.tree_leaves(
+            (state.opt_state, state.target_params))
+        total = 0
+        for leaf in leaves:
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None:
+                shape = tuple(sharding.shard_shape(shape))
+            itemsize = np.dtype(leaf.dtype).itemsize
+            total += int(math.prod(shape)) * itemsize
+        return total
+    except Exception:
+        return None
+
+
 def _aot_compile(train_step, state, batch, mesh):
     """AOT lower+compile the step ONCE; returns (compiled, stats).
 
@@ -247,6 +283,9 @@ def _aot_compile(train_step, state, batch, mesh):
         compiled = fn.lower(state, batch).compile()
     stats = {"compile_seconds": round(time.perf_counter() - t0, 2),
              "h2d_bytes_per_step": _batch_h2d_bytes(batch)}
+    opt_bytes = _optimizer_state_bytes(state)
+    if opt_bytes is not None:
+        stats["optimizer_state_bytes"] = opt_bytes
     stats.update(_memory_stats(compiled))
     return compiled, stats
 
@@ -513,6 +552,23 @@ def main():
     if "--data" in sys.argv[1:]:
         _data_pipeline_bench()     # host-only: no accelerator preflight
         return
+    # --cpu-devices N: size a virtual CPU mesh for off-hardware captures
+    # (the --zero1-ab 1/N scaling rows need several mesh sizes).  Must run
+    # before any backend touch; forces the cpu platform so a half-up TPU
+    # tunnel cannot race the override into a mixed backend.
+    n_cpu = _int_flag("--cpu-devices", 0)
+    if n_cpu:
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_num_cpu_devices", n_cpu)
+        except AttributeError:
+            # pre-0.4.38 jax: the XLA_FLAGS spelling does the same job as
+            # long as the backend is still uninitialized (tests/conftest.py
+            # uses the identical fallback)
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={n_cpu}"
+            ).strip()
     # Optional arch override (e.g. --arch vit_b16, the BASELINE.json
     # config-5 encoder swap).  Non-default archs measure into their OWN
     # partial file so they can never rotate away the committed resnet50
@@ -562,7 +618,7 @@ def main():
     if not _preflight_backend():
         mode = {"--sweep", "--profile", "--stem-ab", "--mvc",
                 "--accum-ladder", "--dry-compile", "--input-ladder",
-                "--telemetry-ab"} \
+                "--telemetry-ab", "--zero1-ab"} \
             & set(sys.argv[1:])
         if mode:
             # only the headline has a committed artifact to fall back to;
@@ -692,6 +748,9 @@ def main():
         return
     if "--telemetry-ab" in sys.argv[1:]:
         _telemetry_ab(arch, image_size, on_tpu, attn_impl)
+        return
+    if "--zero1-ab" in sys.argv[1:]:
+        _zero1_ab(arch, image_size, on_tpu, attn_impl)
         return
 
     value = best_throughput("tpu_first", half=True, fuse_views=True,
@@ -1499,6 +1558,83 @@ def _telemetry_ab(arch, image_size, on_tpu, attn_impl):
         "telemetry_interval": interval,
         "batch_per_chip": bs, "arch": arch, "image_size": image_size,
         "timing_steps": steps,
+        "device_kind": jax.devices()[0].device_kind,
+    }))
+
+
+def _zero1_ab(arch, image_size, on_tpu, attn_impl):
+    """ZeRO-1 A/B (``--zero1-ab``): the SAME accumulation config AOT-
+    compiled twice — replicated (``--zero1 off``, the pre-plan graph) vs
+    flat leaf-partitioned weight-update sharding (``--zero1 on``) — with
+    no execution (the ``--dry-compile`` discipline: memory_analysis() is
+    the deliverable, and the off-hardware CPU mesh can report it too).
+
+    Per row: ``hbm_high_water_bytes`` (executable high-water) and
+    ``optimizer_state_bytes`` — per-chip bytes of LARS momentum + the EMA
+    target computed from the leaf SHARDINGS, the column that must scale
+    ~1/N with mesh size when ZeRO-1 is doing its job.  The printed JSON
+    line carries both rows plus the on/off ratio; expected ratio ~=
+    (1/N + padding) with params replicated either way.
+    """
+    eff = _int_flag("--effective-batch", 4096 if on_tpu else 64)
+    mb = _int_flag("--microbatch", 256 if on_tpu else 16)
+    policy = _str_flag("--remat-policy", "dots")
+    bn_mode = _str_flag("--accum-bn-mode", "average")
+    from byol_tpu.core.remat import validate_policy
+    validate_policy(policy)
+    if eff % mb:
+        raise SystemExit(
+            f"bench: effective batch {eff} not divisible by microbatch {mb}")
+    accum = eff // mb
+    rows = {}
+    for z in ("off", "on"):
+        name = f"zero1_{z}"
+        tags = {"zero1": z, "effective_batch_per_chip": eff,
+                "microbatch_per_chip": mb, "accum_steps": accum,
+                "remat_policy": policy, "accum_bn_mode": bn_mode,
+                "n_devices": len(jax.devices())}
+        try:
+            # shares _build with every measured rung: the A/B's config
+            # cannot drift from the config the ladders measure
+            state, train_step, batch, mesh = _build(
+                eff, image_size, arch, half=on_tpu, fuse_views=True,
+                ema_update_mode="post", attn_impl=attn_impl,
+                accum_steps=accum, accum_bn_mode=bn_mode,
+                remat_policy=policy, zero1=z, materialize_batch=False)
+            compiled, stats = _aot_compile(train_step, state, batch, mesh)
+            del compiled, state, train_step
+        except Exception as e:
+            if _config_failed(f"zero1-ab arm {name}", e):
+                break
+            _record(name, fit=False, **tags, error=repr(e)[:300])
+            continue
+        rows[z] = {**tags, **stats}
+        _record(name, fit=True, **rows[z])
+        print(f"bench: {name}: opt_state={stats.get('optimizer_state_bytes')}"
+              f" hbm={stats.get('hbm_high_water_bytes')} "
+              f"compile={stats.get('compile_seconds')}s", file=sys.stderr)
+    ratio = None
+    if "off" in rows and "on" in rows:
+        off_b = rows["off"].get("optimizer_state_bytes")
+        on_b = rows["on"].get("optimizer_state_bytes")
+        # _optimizer_state_bytes is best-effort (None on exotic states):
+        # either arm missing the column degrades the ratio, not the run
+        if off_b and on_b:
+            ratio = round(on_b / off_b, 4)
+    print(json.dumps({
+        "metric": "zero1_ab_optimizer_state_bytes",
+        "value": rows.get("on", {}).get("optimizer_state_bytes"),
+        "unit": "bytes/chip",
+        "vs_baseline": ratio,       # on/off — ~1/N + padding
+        "replicated_optimizer_state_bytes":
+            rows.get("off", {}).get("optimizer_state_bytes"),
+        "hbm_high_water_off": rows.get("off", {}).get(
+            "hbm_high_water_bytes"),
+        "hbm_high_water_on": rows.get("on", {}).get("hbm_high_water_bytes"),
+        "n_devices": len(jax.devices()),
+        "arch": arch, "image_size": image_size,
+        "effective_batch_per_chip": eff, "microbatch_per_chip": mb,
+        "accum_steps": accum, "remat_policy": policy,
         "device_kind": jax.devices()[0].device_kind,
     }))
 

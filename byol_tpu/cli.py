@@ -171,10 +171,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "pods (slice-major layout: gradient/SyncBN "
                         "all-reduces decompose into in-slice ICI + "
                         "cross-slice DCN phases)")
+    x.add_argument("--zero1", type=str, default=None,
+                   choices=("off", "on"),
+                   help="ZeRO-1 weight-update sharding (arXiv "
+                        "2004.13336): 'on' shards LARS momentum + the EMA "
+                        "target flat leaf-partitioned over the data axis "
+                        "— per-shard update after the gradient reduce, "
+                        "one just-in-time all-gather of fresh params — "
+                        "for ~Nx less optimizer-state HBM per chip; "
+                        "'off' lowers the replicated graph unchanged "
+                        "(parallel/compile_plan.py)")
     x.add_argument("--fsdp", action="store_true",
-                   help="ZeRO-style weight-update sharding: shard the "
-                        "optimizer/EMA/Polyak trees over the data axis "
-                        "(~Nx less aux-state HBM per chip)")
+                   help=argparse.SUPPRESS)  # deprecated alias: --zero1 on
     x.add_argument("--fuse-views", action="store_true",
                    help="one fused encoder call for both views (perf; "
                         "changes BN batch statistics vs the reference)")
@@ -270,6 +278,14 @@ def config_from_args(args: argparse.Namespace) -> Config:
     import jax
     n_rep = args.num_replicas or jax.device_count() // (
         args.model_parallel * args.sequence_parallel)
+    # --fsdp is the pre-ZeRO-1 spelling of --zero1 on; an explicit
+    # --zero1 off alongside it is a contradiction, not an override —
+    # silently picking either side would discard an explicit flag
+    if args.fsdp and args.zero1 == "off":
+        raise SystemExit(
+            "cli: --fsdp is the deprecated alias for --zero1 on; it "
+            "conflicts with the explicit --zero1 off also passed")
+    zero1 = "on" if args.fsdp else (args.zero1 or "off")
     return Config(
         task=TaskConfig(
             task=args.task, data_dir=args.data_dir,
@@ -327,7 +343,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             model_parallel=args.model_parallel,
             sequence_parallel=args.sequence_parallel,
             dcn_data_parallel=args.dcn_data_parallel,
-            fsdp=args.fsdp),
+            zero1=zero1),
         parity=ParityConfig(
             loss_norm_mode=args.loss_norm_mode,
             ema_init_mode=args.ema_init_mode,

@@ -204,10 +204,15 @@ class DeviceConfig:
     dcn_data_parallel: int = 1          # ICI slices the data axis spans
                                         # (multi-slice pods: in-slice ICI +
                                         # cross-slice DCN collectives)
-    fsdp: bool = False                  # ZeRO-style weight-update sharding:
-                                        # optimizer/EMA/Polyak trees sharded
-                                        # over the data axis (params stay
-                                        # replicated for the forward)
+    zero1: str = "off"                  # ZeRO-1 weight-update sharding
+                                        # (arXiv 2004.13336): 'on' shards
+                                        # LARS momentum + the EMA target
+                                        # flat leaf-partitioned over the
+                                        # data axis (params stay replicated
+                                        # for the forward; ~Nx less aux-
+                                        # state HBM per chip); 'off' lowers
+                                        # the replicated graph unchanged.
+                                        # parallel/{compile_plan,zero1}.py
 
 
 @_frozen
@@ -334,6 +339,17 @@ def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
         raise ValueError(
             f"unknown nan_policy {cfg.device.nan_policy!r}; "
             "'warn' | 'halt'")
+    if cfg.device.zero1 not in ("off", "on"):
+        raise ValueError(
+            f"unknown zero1 mode {cfg.device.zero1!r}; 'off' | 'on'")
+    if cfg.device.zero1 == "on" and cfg.device.model_parallel > 1:
+        # ZeRO-1 is data-parallel weight-update sharding; a TP'd head's
+        # opt-state leaves are already sharded over 'model'
+        # (parallel/partitioning.py) and the flat layout would clobber that
+        raise ValueError(
+            "--zero1 on does not compose with --model-parallel > 1 "
+            "(tensor parallelism already shards those optimizer-state "
+            "leaves over the 'model' axis)")
     if cfg.device.nan_policy == "halt" and cfg.device.telemetry == "off":
         # the sink that enforces halt only exists when telemetry is on —
         # accepting this combination would silently train through NaNs,

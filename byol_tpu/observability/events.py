@@ -53,6 +53,13 @@ EVENT_KINDS: Dict[str, tuple] = {
     "run_end": (),
 }
 
+# run_header.sharding_plan (CompilePlan.describe()): OPTIONAL — bench
+# headers have no mesh — but when present it must carry the full plan
+# provenance, or a run log could claim a plan it cannot name.  Optional-
+# field shape checks are additive (no SCHEMA_VERSION bump).
+SHARDING_PLAN_FIELDS = ("mesh_shape", "axis_names", "zero1",
+                        "donate_argnums")
+
 
 def _sanitize(obj: Any) -> Any:
     """JSON-strict deep copy of a payload: non-finite floats become the
@@ -112,6 +119,21 @@ def validate_event(event: Any) -> Dict[str, Any]:
     if missing:
         raise ValueError(
             f"event kind {kind!r} missing required field(s) {missing}")
+    if kind == "run_header" and "sharding_plan" in event:
+        sp = event["sharding_plan"]
+        if not isinstance(sp, dict):
+            raise ValueError(
+                f"run_header.sharding_plan must be an object, got "
+                f"{type(sp).__name__}")
+        sp_missing = [f for f in SHARDING_PLAN_FIELDS if f not in sp]
+        if sp_missing:
+            raise ValueError(
+                f"run_header.sharding_plan missing field(s) {sp_missing} "
+                f"(expected {list(SHARDING_PLAN_FIELDS)})")
+        if sp.get("zero1") not in ("off", "on"):
+            raise ValueError(
+                f"run_header.sharding_plan.zero1 must be 'off'|'on', got "
+                f"{sp.get('zero1')!r}")
     return event
 
 

@@ -69,6 +69,7 @@ def build_optimizer(opt_name: str, *,
                     clip: float = 0.0,
                     trust_coefficient: float = 1e-3,
                     lars_eps: float = 0.0,
+                    adapt_mask: Optional[Any] = None,
                     ) -> Tuple[optax.GradientTransformation, optax.Schedule]:
     """Build the full gradient transformation + the lr schedule (returned
     separately so the driver can log lr per epoch, main.py:763-764).
@@ -76,6 +77,13 @@ def build_optimizer(opt_name: str, *,
     ``total_units``/``warmup_units`` are in schedule units; pass epochs and
     set ``steps_per_epoch`` for reference-parity epoch-granular stepping
     (Quirk Q5), or pass steps directly with ``steps_per_epoch=None``.
+
+    ``adapt_mask``: optional PRECOMPUTED bias/BN exclusion mask tree for
+    LARS adaptation / weight decay.  The default (None) derives the mask
+    from leaf ndim at update time — correct on the shaped param tree, but
+    under ZeRO-1 the transforms see the FLAT leaf-partitioned trees
+    (parallel/zero1.py) where every leaf is 1-D, so the caller must pass
+    the mask computed on the real shapes.
     """
     full = opt_name.lower().strip()
     if full == "lars":
@@ -99,14 +107,17 @@ def build_optimizer(opt_name: str, *,
     if is_lars:
         chain.append(lars_lib.lars(
             base, weight_decay=weight_decay,
-            trust_coefficient=trust_coefficient, eps=lars_eps))
+            trust_coefficient=trust_coefficient, eps=lars_eps,
+            mask=adapt_mask))
     else:
         if weight_decay > 0.0:
             # torch-style L2: grad += wd*p for every param (torch applies wd
             # to ALL params when passed per-group; add_weight_decay gives the
             # no-decay group wd=0, so mask bias/BN here identically).
             chain.append(optax.add_decayed_weights(
-                weight_decay, mask=lars_lib.default_exclusion_mask))
+                weight_decay,
+                mask=(adapt_mask if adapt_mask is not None
+                      else lars_lib.default_exclusion_mask)))
         chain.append(base)
 
     return optax.chain(*chain), schedule

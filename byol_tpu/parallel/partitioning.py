@@ -20,27 +20,22 @@ The matcher walks tree PATHS, so the same rules shard the online params, the
 EMA target tree, the Polyak tree, and every params-shaped subtree inside the
 optax state (momentum buffers carry the same path suffixes).
 
-**FSDP / ZeRO-style weight-update sharding** (``fsdp=True``): beyond the
-reference's full-replica layout, the auxiliary state trees — optimizer
-state, EMA target, Polyak — are sharded over the DATA axis (first divisible
-array axis).  Online params/BN stats stay replicated for the forward, so
-this is the cross-replica *weight-update* sharding of SURVEY §2.2's stretch
-row: per-chip HBM for aux state drops ~Nx, and GSPMD inserts the
-gather/scatter around the optimizer update and the target forward.
+These rules are the BASE layout consumed by the compile plan
+(parallel/compile_plan.py) — the one module that owns the jit wiring for
+every entry point.  ZeRO-1 weight-update sharding (``--zero1 on``, the
+successor of the old first-divisible-axis ``fsdp`` heuristic) is layered on
+top by the plan via the flat leaf-partitioned layout in parallel/zero1.py.
 """
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from byol_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from byol_tpu.parallel.mesh import MODEL_AXIS
 
 _TP_MODULES = ("projector", "predictor")
-# TrainState fields carrying aux (non-forward-critical) replicas of the
-# param tree; these are what FSDP mode shards over the data axis.
-_FSDP_STATE_FIELDS = ("opt_state", "target_params", "polyak_params")
 
 
 def _path_names(path) -> tuple:
@@ -72,39 +67,18 @@ def leaf_pspec(path, leaf) -> P:
     return P()
 
 
-def fsdp_leaf_pspec(path, leaf, data_size: int) -> Optional[P]:
-    """Data-axis spec for aux-state leaves (None = not an FSDP target)."""
-    names = _path_names(path)
-    if not names or names[0] not in _FSDP_STATE_FIELDS:
-        return None
-    shape = getattr(leaf, "shape", ())
-    for axis, dim in enumerate(shape):
-        if dim >= data_size and dim % data_size == 0:
-            spec = [None] * len(shape)
-            spec[axis] = DATA_AXIS
-            return P(*spec)
-    return None                      # no divisible axis: stay replicated
-
-
-def state_shardings(state: Any, mesh: Mesh, *, fsdp: bool = False) -> Any:
+def state_shardings(state: Any, mesh: Mesh) -> Any:
     """NamedSharding tree for a TrainState (or any params-bearing pytree).
 
-    Defaults (size-1 model axis, fsdp off) degenerate to fully-replicated —
-    the data-parallel layout the reference uses (full DDP replicas).
+    The default (size-1 model axis) degenerates to fully-replicated — the
+    data-parallel layout the reference uses (full DDP replicas).
     """
     tp = mesh.shape.get(MODEL_AXIS, 1) > 1
-    data_size = mesh.shape.get(DATA_AXIS, 1)
-    use_fsdp = fsdp and data_size > 1
-    if not tp and not use_fsdp:
+    if not tp:
         return jax.tree_util.tree_map(
             lambda _: NamedSharding(mesh, P()), state)
 
     def spec_for(path, leaf):
-        spec = leaf_pspec(path, leaf) if tp else P()
-        if use_fsdp and spec == P():
-            fs = fsdp_leaf_pspec(path, leaf, data_size)
-            if fs is not None:
-                spec = fs
-        return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, leaf_pspec(path, leaf))
 
     return jax.tree_util.tree_map_with_path(spec_for, state)

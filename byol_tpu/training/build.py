@@ -4,12 +4,14 @@ The analog of the reference's ``build_loader_model_grapher`` +
 ``build_optimizer`` wiring (main.py:403-462, 303-344), minus the loader/
 grapher (owned by :mod:`byol_tpu.data` / :mod:`byol_tpu.observability`).
 
-Sharding layout (GSPMD):
-- batch dims   : sharded over the ``data`` mesh axis;
-- params, target EMA, optimizer state, BN stats: replicated (the reference
-  keeps full replicas too — FSDP-style sharding is an extension, SURVEY §2.2).
-The jitted step constrains inputs/outputs to these shardings; XLA inserts all
-collectives (gradient allreduce, SyncBN psum) from the partitioning.
+Sharding layout (GSPMD): declared by the compile plan
+(parallel/compile_plan.py) — batch dims over the ``data`` mesh axis;
+params/BN stats replicated for the forward; LARS momentum + the EMA target
+replicated by default (the reference keeps full replicas too) or flat
+leaf-partitioned over ``data`` under ``--zero1 on`` (parallel/zero1.py).
+The jitted steps take their in/out shardings and donation from the plan;
+XLA inserts all collectives (gradient allreduce, SyncBN psum, the ZeRO-1
+scatter/gather) from the partitioning.
 """
 from __future__ import annotations
 
@@ -18,7 +20,7 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from byol_tpu.core.config import Config, ResolvedConfig
 from byol_tpu.core.precision import get_policy
@@ -82,11 +84,12 @@ def init_variables(net: BYOLNet, rcfg: ResolvedConfig, rng: jax.Array,
     return net.init({"params": rng}, dummy, train=True, method="warmup")
 
 
-def build_tx(rcfg: ResolvedConfig):
+def build_tx(rcfg: ResolvedConfig, adapt_mask=None):
     cfg = rcfg.cfg
     epoch_granular = cfg.parity.schedule_granularity == "epoch"
     return build_optimizer(
         cfg.optim.optimizer,
+        adapt_mask=adapt_mask,
         base_lr=cfg.optim.lr,
         global_batch_size=rcfg.global_batch_size,
         weight_decay=cfg.regularizer.weight_decay,
@@ -165,15 +168,26 @@ def _validate_remat_tags(net, rcfg: ResolvedConfig, variables,
                                    policy_name=policy_name)
 
 
-def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array
+def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array,
+                   plan: Optional[Any] = None
                    ) -> Tuple[BYOLNet, TrainState, Callable, Callable, Any]:
     """Returns (net, sharded_state, jitted_train_step, jitted_eval_step,
-    lr_schedule)."""
+    lr_schedule).
+
+    ALL sharding decisions — state layout (replicated / TP / ZeRO-1),
+    batch placement, in/out shardings and donation of both jitted steps —
+    come from the compile plan (parallel/compile_plan.py).  Callers that
+    need the plan afterwards (the trainer: run-log provenance + the
+    checkpoint canonicalization codec) build it themselves and pass it in;
+    ``None`` builds the config-implied plan internally.
+    """
     cfg = rcfg.cfg
     policy = get_policy(cfg.device.half)
     net = build_net(rcfg)
-    tx, schedule = build_tx(rcfg)
     scfg = step_config(rcfg)
+    from byol_tpu.parallel.compile_plan import build_plan
+    if plan is None:
+        plan = build_plan(mesh, zero1=cfg.device.zero1 == "on")
 
     from byol_tpu.core.rng import split_named
     keys = split_named(rng, ("params", "weight_init"))
@@ -189,29 +203,32 @@ def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array
             variables["params"] = apply_weight_init(
                 variables["params"], keys["weight_init"],
                 cfg.model.weight_initialization)
+        # Under ZeRO-1 the optax chain sees FLAT leaves (every leaf 1-D),
+        # so the bias/BN exclusion mask must be fixed from the REAL shapes
+        # here; the default ndim-derived mask stays for the replicated
+        # layout (identical semantics, and bit-identical jit cache keys).
+        adapt_mask = None
+        if plan.zero1:
+            from byol_tpu.optim.lars import default_exclusion_mask
+            adapt_mask = default_exclusion_mask(variables["params"])
+        tx, schedule = build_tx(rcfg, adapt_mask=adapt_mask)
         state = create_train_state(
-            variables, tx,
+            # under ZeRO-1 the plan inits the optimizer state on the FLAT
+            # params in prepare_state; initializing the replicated tree
+            # here too would double the setup-time momentum footprint
+            variables, None if plan.zero1 else tx,
             ema_init_mode=cfg.parity.ema_init_mode,
             polyak_ema=cfg.regularizer.polyak_ema)
 
-    replicated = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, P(DATA_AXIS))
-    # State layout: replicated for pure DP (the reference's full-replica
-    # model); TP rules shard the MLP-head params/EMA/opt-state over the
-    # 'model' axis when it is >1 (parallel/partitioning.py).
-    from byol_tpu.parallel.partitioning import state_shardings
-    state_sh = state_shardings(state, mesh, fsdp=cfg.device.fsdp)
-    state = jax.device_put(state, state_sh)
+    # The plan converts the state to its layout (ZeRO-1: flat-sharded
+    # momentum/EMA), places it, and owns the jit wiring of both steps.
+    state, state_sh = plan.prepare_state(state, tx)
+    z1 = plan.zero1_context()
 
-    train_step = jax.jit(
-        make_train_step(net, tx, scfg, policy),
-        in_shardings=(state_sh, batch_sh),
-        out_shardings=(state_sh, replicated),
-        donate_argnums=(0,))
-    eval_step = jax.jit(
-        make_eval_step(net, scfg, policy),
-        in_shardings=(state_sh, batch_sh),
-        out_shardings=replicated)
+    train_step = plan.jit_train_step(
+        make_train_step(net, tx, scfg, policy, zero1_ctx=z1), state_sh)
+    eval_step = plan.jit_eval_step(
+        make_eval_step(net, scfg, policy, zero1_ctx=z1), state_sh)
 
     def _with_mesh(fn):
         # keep the mesh in thread-local scope at call (=trace) time so

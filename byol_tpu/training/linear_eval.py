@@ -99,22 +99,24 @@ def encoder_extractor_spmd(net, state, mesh, *, half: bool = False,
                            normalize: bool = False) -> Callable:
     """SPMD frozen-encoder extractor: ``(x, y, mask)`` global arrays in,
     REPLICATED ``(features_fp32, y, mask)`` out — the replicated
-    out_shardings is the cross-host all-gather, so every host can read the
-    full result with a plain ``np.asarray``."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
+    out_shardings (declared by the compile plan, which owns every jit
+    entry point's shardings) is the cross-host all-gather, so every host
+    can read the full result with a plain ``np.asarray``."""
     from byol_tpu.core.precision import get_policy
+    from byol_tpu.parallel.compile_plan import build_plan
     policy = get_policy(half)
-    rep = NamedSharding(mesh, P())
+    # Extraction reads only params/batch_stats, which stay replicated under
+    # every plan (ZeRO-1 shards momentum/EMA only) — the default plan's
+    # extractor wiring serves states trained under any layout.
+    plan = build_plan(mesh)
 
-    @functools.partial(jax.jit, out_shardings=(rep, rep, rep))
     def apply(x, y, mask):
         out = net.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             _prep_inputs(x, policy, normalize), train=False, mutable=False)
         return out["representation"].astype(jnp.float32), y, mask
 
-    return apply
+    return plan.jit_spmd_extractor(apply)
 
 
 def extract_features_spmd(apply_fn, batches: Iterator[Dict[str, Any]], mesh,
@@ -306,18 +308,20 @@ def linear_eval(apply_fn: Callable, train_batches: Iterator,
 
 def encoder_apply_fn(net, state, *, half: bool = False,
                      normalize: bool = False) -> Callable:
-    """Jitted frozen-encoder feature extractor from a TrainState."""
+    """Jitted frozen-encoder feature extractor from a TrainState (the
+    single-host entry point; its default-placement jit wiring is declared
+    in the compile plan alongside the sharded entry points)."""
     from byol_tpu.core.precision import get_policy
+    from byol_tpu.parallel.compile_plan import jit_encoder_extractor
     policy = get_policy(half)
 
-    @jax.jit
     def apply(x):
         out = net.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             _prep_inputs(x, policy, normalize), train=False, mutable=False)
         return out["representation"].astype(jnp.float32)
 
-    return apply
+    return jit_encoder_extractor(apply)
 
 
 def run_linear_eval_from_cfg(cfg, state, *, loader=None, mesh=None,

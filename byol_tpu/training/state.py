@@ -36,9 +36,14 @@ class TrainState:
     polyak_params: Optional[Any] = None  # --polyak-ema tree (main.py:76,625-626)
 
 
-def create_train_state(variables: Any, tx: optax.GradientTransformation,
+def create_train_state(variables: Any,
+                       tx: Optional[optax.GradientTransformation],
                        *, ema_init_mode: str = "copy",
                        polyak_ema: float = 0.0) -> TrainState:
+    """``tx=None`` leaves ``opt_state`` empty: the ZeRO-1 compile plan
+    re-initializes it on the FLAT params in ``prepare_state`` — allocating
+    the full replicated momentum tree here first would raise the setup-time
+    HBM high water by ~1 params-tree for nothing."""
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     if ema_init_mode == "copy":
@@ -57,7 +62,7 @@ def create_train_state(variables: Any, tx: optax.GradientTransformation,
         batch_stats=batch_stats,
         target_params=target,
         ema_step=ema_step,
-        opt_state=tx.init(params),
+        opt_state=tx.init(params) if tx is not None else None,
         polyak_params=(jax.tree_util.tree_map(jnp.array, params)
                        if polyak_ema > 0.0 else None),
     )

@@ -215,7 +215,7 @@ def augment_keys(seed: int, step, k: int) -> jnp.ndarray:
 
 
 def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
-                    policy: Policy = FP32
+                    policy: Policy = FP32, zero1_ctx=None
                     ) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
                                   Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build the jittable train step: (state, batch) -> (state, metrics).
@@ -224,6 +224,17 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
     pixels in [0,1] (the reference input contract, main.py:486-490).
     B is the EFFECTIVE batch; with ``accum_steps`` k > 1 it is split into k
     microbatches inside the step (module docstring).
+
+    ``zero1_ctx`` (parallel.zero1.Zero1Context, from the compile plan):
+    ZeRO-1 weight-update sharding.  When set, ``state.target_params`` and
+    ``state.opt_state`` arrive FLAT leaf-partitioned over the data axis:
+    the step all-gathers the EMA target just-in-time for the target
+    forward, scatters the reduced gradients + params to their flat shards,
+    runs the whole optax chain shard-local, all-gathers only the fresh
+    params for the next forward, and ticks the EMA on its shard (the tick
+    is elementwise, arXiv 2307.13813 — it never needs the full tree).
+    ``None`` traces the replicated graph unchanged (``--zero1 off`` HLO
+    identity, tests/test_zero1.py).
     """
     if scfg.accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {scfg.accum_steps}")
@@ -374,6 +385,15 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
     def train_step(state: TrainState, batch):
         labels = batch["label"]
         k = scfg.accum_steps
+        if zero1_ctx is not None:
+            # ZeRO-1: the EMA target arrives flat-sharded; gather it
+            # just-in-time for the target forwards.  The microbatch paths
+            # read the target off the state they are handed, so hand them
+            # a view with the gathered tree in place.
+            micro_state = state.replace(target_params=zero1_ctx.gather(
+                state.target_params, zero1_ctx.param_template))
+        else:
+            micro_state = state
         if scfg.augment_in_step:
             keys = augment_keys(scfg.aug_seed, state.step, k)
             parts = {"images": batch["images"], "label": labels}
@@ -383,8 +403,8 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
         if k == 1:
             if scfg.augment_in_step:
                 parts["key"] = keys[0]
-            grads, new_bs, metrics = micro_step(state, state.batch_stats,
-                                                parts)
+            grads, new_bs, metrics = micro_step(micro_state,
+                                                state.batch_stats, parts)
         else:
             xs = {name: _microbatch_split(v, k)
                   for name, v in parts.items()}
@@ -393,17 +413,41 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
             accumulate = (accumulate_global
                           if scfg.accum_bn_mode == "global"
                           else accumulate_scan)
-            grads, new_bs, metrics = accumulate(state, xs)
+            grads, new_bs, metrics = accumulate(micro_state, xs)
 
-        updates, new_opt_state = tx.update(grads, state.opt_state,
-                                           state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if zero1_ctx is None:
+            updates, new_opt_state = tx.update(grads, state.opt_state,
+                                               state.params)
+            new_params = optax.apply_updates(state.params, updates)
+        else:
+            # Per-shard weight update (arXiv 2004.13336): the reduced
+            # gradient and the params scatter to their flat 1/N shards
+            # (free: both are replicated, each chip keeps a slice), the
+            # optax chain runs shard-local — LARS norms are unchanged by
+            # the zero padding — and ONE all-gather rebuilds the fresh
+            # params just-in-time for the next forward.
+            flat_params = zero1_ctx.shard(state.params)
+            flat_grads = zero1_ctx.shard(grads)
+            updates, new_opt_state = tx.update(flat_grads, state.opt_state,
+                                               flat_params)
+            new_params_flat = optax.apply_updates(flat_params, updates)
+            new_params = zero1_ctx.gather(new_params_flat,
+                                          zero1_ctx.param_template)
 
         # Cosine-annealed EMA of the full tree (main.py:156-162,255).
         tau = cosine_ema_decay(state.ema_step, scfg.total_train_steps,
                                scfg.base_decay)
-        ema_src = (state.params if scfg.ema_update_mode == "reference_pre"
-                   else new_params)
+        if zero1_ctx is None:
+            ema_src = (state.params
+                       if scfg.ema_update_mode == "reference_pre"
+                       else new_params)
+        else:
+            # the tick is elementwise, so it runs on the flat shards and
+            # the target STAYS sharded — it is re-gathered at the top of
+            # the next step, just-in-time for the target forward
+            ema_src = (flat_params
+                       if scfg.ema_update_mode == "reference_pre"
+                       else new_params_flat)
         new_target = jax.tree_util.tree_map(
             lambda t, p: tau * t + (1.0 - tau) * p,
             state.target_params, ema_src)
@@ -442,8 +486,14 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
                                                     state.params)
             else:
                 trust = jnp.ones((1,), jnp.float32)
+            # Under ZeRO-1 the target tree is flat-sharded, so the drift
+            # subtraction needs the params in the SAME layout; zero
+            # padding contributes nothing to any norm, so every reported
+            # value is identical to the replicated step's.
+            health_params = (new_params if zero1_ctx is None
+                             else new_params_flat)
             metrics["health"] = health_lib.health_stats(
-                grads=grads, updates=updates, params=new_params,
+                grads=grads, updates=updates, params=health_params,
                 target_params=new_target, loss=metrics["loss_mean"],
                 collapse=collapse, trust_ratios=trust)
 
@@ -461,11 +511,15 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
     return train_step
 
 
-def make_eval_step(net, scfg: StepConfig, policy: Policy = FP32):
+def make_eval_step(net, scfg: StepConfig, policy: Policy = FP32,
+                   zero1_ctx=None):
     """Eval step per reference semantics (main.py:574-606, §3.3): full BYOL
     loss computed in eval too; probe sees only view-1 representations with
     un-doubled labels (main.py:250-251); EMA frozen; BN uses running stats;
-    Polyak params used for prediction when enabled (main.py:585-587)."""
+    Polyak params used for prediction when enabled (main.py:585-587).
+
+    ``zero1_ctx``: as in :func:`make_train_step` — the flat-sharded EMA
+    target is all-gathered just-in-time for the target forward."""
 
     def eval_step(state: TrainState, batch):
         aug1 = policy.cast_to_compute(batch["view1"])
@@ -483,11 +537,16 @@ def make_eval_step(net, scfg: StepConfig, policy: Policy = FP32):
         if scfg.polyak_ema > 0.0 and state.polyak_params is not None:
             params = state.polyak_params
 
+        target_params = state.target_params
+        if zero1_ctx is not None:
+            target_params = zero1_ctx.gather(target_params,
+                                             zero1_ctx.param_template)
+
         on1, on2, _ = _forward_views(
             net, params, state.batch_stats, aug1, aug2,
             train=False, fuse=scfg.fuse_views, update_stats=False)
         tgt1, tgt2, _ = _forward_views(
-            net, state.target_params, state.batch_stats, aug1, aug2,
+            net, target_params, state.batch_stats, aug1, aug2,
             train=False, fuse=scfg.fuse_views, update_stats=False)
 
         byol_loss = loss_function(

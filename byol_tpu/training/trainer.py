@@ -118,9 +118,16 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                    input_shape=loader.input_shape,
                    num_valid_samples=loader.num_valid_samples)
 
+    # The compile plan (parallel/compile_plan.py) owns every sharding
+    # decision; the trainer holds it for run-log provenance and for the
+    # checkpoint codec (ZeRO-1 state is canonicalized at the save/restore
+    # boundary so checkpoints stay mesh-size portable).
+    from byol_tpu.parallel.compile_plan import build_plan
+    plan = build_plan(mesh, zero1=cfg.device.zero1 == "on")
+
     from byol_tpu.core.rng import root_key
     net, state, train_step, eval_step, schedule = setup_training(
-        rcfg, mesh, root_key(cfg.device.seed))
+        rcfg, mesh, root_key(cfg.device.seed), plan=plan)
     if verbose:
         from byol_tpu.utils import number_of_parameters
         print(f"model: {cfg.model.arch}, "
@@ -166,7 +173,10 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
             mesh_shape={str(k): int(v) for k, v in mesh.shape.items()},
             n_devices=jax.device_count(),
             steps_per_train_epoch=rcfg.steps_per_train_epoch,
-            global_batch_size=rcfg.global_batch_size)
+            global_batch_size=rcfg.global_batch_size,
+            # which compile plan produced this run: mesh axes, zero1
+            # on/off, per-entry-point donation (events.py validates shape)
+            sharding_plan=plan.describe())
 
     # Telemetry sink: asynchronous (>= interval-step lag) readback of the
     # in-graph health vector + anomaly rules.  Created on EVERY process so
@@ -219,12 +229,24 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                     break
         return acc
 
+    # Checkpoints always store the CANONICAL state layout (replicated,
+    # unflattened — identical to the plan layout unless zero1 is on), so a
+    # ckpt written under either --zero1 flag or any mesh size restores
+    # under any other (reshard-on-restore, tests/test_checkpoint.py).
+    def _save_state(state):
+        return plan.to_canonical(state)
+
+    def _restore(template_state, *, best):
+        restored, epoch = saver.restore(
+            plan.canonical_template(template_state), best=best)
+        return plan.from_canonical(restored), epoch
+
     init_epoch = 0
     if saver.stopped_early:
         # This run already early-stopped (durable marker in the checkpoint
         # metadata): restore the best state and return without re-burning
         # patience-worth of epochs.
-        state, init_epoch = saver.restore(state, best=True)
+        state, init_epoch = _restore(state, best=True)
         acc = run_eval(state)
         test_metrics = {k: float(v) for k, v in acc.result().items()}
         watchdog.stop()
@@ -246,7 +268,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         # here would silently discard all post-best training and reset the
         # persisted patience counter on every relaunch.  Best-restore is
         # reserved for the early-stop terminal path (main.py:767-769).
-        state, init_epoch = saver.restore(state, best=False)
+        state, init_epoch = _restore(state, best=False)
         if not cfg.device.debug_step:
             # A preemption checkpoint (save-on-SIGTERM) lands mid-epoch: the
             # step counter is then not a multiple of steps_per_epoch.  Data
@@ -292,7 +314,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         # step/EMA counters are exact; the relaunch detects the mid-epoch
         # counter (step % steps_per_epoch != 0), re-enters this epoch and
         # skips the batches already trained — an exact resume.
-        saver.store.save(epoch, state, is_best=False)
+        saver.store.save(epoch, _save_state(state), is_best=False)
         saver.store._ckptr.wait_until_finished()
         print(f"SIGTERM: checkpointed epoch {epoch} at step "
               f"{int(state.step)}; exiting 143 for requeue")
@@ -526,7 +548,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         watchdog.pet()
         with profiling.annotate("byol/checkpoint"):
             stop_now = saver(test_metrics.get("loss_mean", float("inf")),
-                             epoch, state)
+                             epoch, _save_state(state))
         watchdog.pet()
         if events is not None:
             events.emit("checkpoint", epoch=epoch, step=global_step,
@@ -534,7 +556,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                         best_metric=saver.best_metric,
                         early_stop=bool(stop_now))
         if stop_now:
-            state, _ = saver.restore(state, best=True)
+            state, _ = _restore(state, best=True)
             acc = run_eval(state)
             test_metrics = {k: float(v) for k, v in acc.result().items()}
             stopped = True

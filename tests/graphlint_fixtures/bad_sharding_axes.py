@@ -1,0 +1,42 @@
+"""GL107 must-fire corpus: sharding-spec drift.
+
+Three bugs:
+1. a PartitionSpec string literal naming an axis the declared vocabulary
+   (DATA_AXIS / AXIS_NAMES below) does not contain — the classic typo that
+   silently replicates what the author believed was sharded;
+2. the same drift routed through a module-level string constant;
+3. a ``jax.jit(..., in_shardings=...)`` outside parallel/compile_plan.py —
+   a per-site sharding decision the compile plan exists to forbid.
+"""
+import functools
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+AXIS_NAMES = (DATA_AXIS, MODEL_AXIS)
+
+GHOST_AXIS = "modle"          # the typo'd spelling of 'model'
+
+
+def constrain(x):
+    # BUG: 'dataa' is not a declared axis
+    return jax.lax.with_sharding_constraint(x, P("dataa", None))
+
+
+def constrain_via_const(x):
+    # BUG: the constant resolves to 'modle', which nothing declares
+    return jax.lax.with_sharding_constraint(x, P(GHOST_AXIS))
+
+
+def jit_with_inline_shardings(mesh, fn):
+    # BUG: in_shardings outside parallel/compile_plan.py
+    sharded = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.jit(fn, in_shardings=(sharded,))
+
+
+def partial_jit_with_inline_shardings(mesh, fn):
+    # BUG: same hazard through functools.partial
+    rep = NamedSharding(mesh, P())
+    return functools.partial(jax.jit, out_shardings=rep)(fn)

@@ -1,0 +1,42 @@
+"""GL107 near-miss corpus: everything here must stay clean.
+
+Specs name only declared axes (directly, via the module constants, and as
+literals matching the declared vocabulary); ``None`` entries and
+unresolvable dynamic specs are never judged; a plain ``jax.jit`` with no
+sharding kwargs is not a plan violation; and a spec built from a name the
+linter cannot resolve (a function argument) is left alone rather than
+guessed at.
+"""
+import jax
+from jax.sharding import PartitionSpec as P
+
+DATA_AXIS = "data"
+SEQUENCE_AXIS = "sequence"
+MODEL_AXIS = "model"
+AXIS_NAMES = (DATA_AXIS, SEQUENCE_AXIS, MODEL_AXIS)
+
+
+def constrain(x):
+    return jax.lax.with_sharding_constraint(x, P(DATA_AXIS, None))
+
+
+def constrain_literal(x):
+    # literal spelling of a declared axis: fine
+    return jax.lax.with_sharding_constraint(x, P("model"))
+
+
+def constrain_nested(x):
+    # tuple entry naming declared axes only
+    return jax.lax.with_sharding_constraint(x, P((DATA_AXIS, "sequence"),
+                                                 None))
+
+
+def constrain_dynamic(x, axis_name):
+    # unresolvable name: the rule must stand down, not guess
+    return jax.lax.with_sharding_constraint(x, P(axis_name))
+
+
+@jax.jit
+def plain_jit(x):
+    # jit without sharding kwargs is not a plan violation
+    return x + 1

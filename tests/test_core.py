@@ -49,6 +49,25 @@ class TestResolve:
                                num_test_samples=100, output_size=10,
                                input_shape=(32, 32, 3))
 
+    def test_zero1_mode_validated(self):
+        """ISSUE 7: bad --zero1 values and the zero1 x TP clash are
+        rejected at resolve(), not at trace time."""
+        kw = dict(num_train_samples=1000, num_test_samples=100,
+                  output_size=10, input_shape=(32, 32, 3))
+        cfg = _cfg(batch_size=64)
+        bad = cfg.replace(
+            device=dataclasses.replace(cfg.device, zero1="sharded"))
+        with pytest.raises(ValueError, match="zero1"):
+            config_lib.resolve(bad, **kw)
+        clash = cfg.replace(
+            device=dataclasses.replace(cfg.device, zero1="on",
+                                       model_parallel=2))
+        with pytest.raises(ValueError, match="model-parallel"):
+            config_lib.resolve(clash, **kw)
+        ok = cfg.replace(
+            device=dataclasses.replace(cfg.device, zero1="on"))
+        assert config_lib.resolve(ok, **kw).cfg.device.zero1 == "on"
+
     def test_run_name_deterministic(self):
         cfg = _cfg(uid="exp1")
         assert config_lib.run_name(cfg) == config_lib.run_name(cfg)

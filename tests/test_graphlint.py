@@ -38,6 +38,7 @@ RULE_CASES = [
     ("GL104", "bad_donate.py", "ok_donate.py"),
     ("GL105", "bad_remat_tags.py", "ok_remat_tags.py"),
     ("GL106", "bad_cli_drift.py", "ok_cli_drift.py"),
+    ("GL107", "bad_sharding_axes.py", "ok_sharding_axes.py"),
 ]
 
 

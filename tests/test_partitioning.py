@@ -16,7 +16,7 @@ from byol_tpu.training.build import setup_training
 
 
 def _setup(mesh, *, data, model=1, sequence=1, arch="resnet18", image=16,
-           fsdp=False, **model_kw):
+           zero1="off", **model_kw):
     cfg = Config(
         task=TaskConfig(task="fake", batch_size=2 * data, epochs=2,
                         image_size_override=image),
@@ -24,7 +24,7 @@ def _setup(mesh, *, data, model=1, sequence=1, arch="resnet18", image=16,
                           **model_kw),
         device=DeviceConfig(num_replicas=data, half=False, seed=0,
                             model_parallel=model,
-                            sequence_parallel=sequence, fsdp=fsdp),
+                            sequence_parallel=sequence, zero1=zero1),
     )
     rcfg = resolve(cfg, num_train_samples=8 * data, num_test_samples=2 * data,
                    output_size=10, input_shape=(image, image, 3))
@@ -66,59 +66,45 @@ def test_dp_mesh_is_fully_replicated(mesh8):
     assert shardings["a"].spec == P()
 
 
-def test_fsdp_pspec_rules(mesh8):
-    """FSDP shards aux-state trees (opt/EMA/Polyak) over the data axis on
-    the first divisible dim; params/batch_stats stay replicated."""
+def test_zero1_plan_sharding_rules(mesh8):
+    """The compile plan layers ZeRO-1 on the base rules: flat (1-D,
+    shard-divisible) leaves under opt_state/target_params get P(data);
+    params and non-flat leaves keep the base (replicated) layout.  Full
+    step-level coverage lives in tests/test_zero1.py — this pins the spec
+    assignment logic itself (the old fsdp_leaf_pspec heuristic's successor,
+    parallel/compile_plan.py)."""
+    from byol_tpu.parallel.compile_plan import build_plan
     from byol_tpu.parallel.mesh import DATA_AXIS
-    from byol_tpu.parallel.partitioning import fsdp_leaf_pspec
 
-    class Key:
-        def __init__(self, key):
-            self.key = key
-
-    k = np.zeros((3, 3, 16, 64))     # conv kernel inside the opt state
-    assert fsdp_leaf_pspec((Key("opt_state"), Key("mu"), Key("kernel")),
-                           k, 8) == P(None, None, DATA_AXIS, None)
-    assert fsdp_leaf_pspec((Key("target_params"), Key("kernel")),
-                           np.zeros((64, 32)), 8) == P(DATA_AXIS, None)
-    # params are NOT an FSDP target; tiny/non-divisible leaves replicate
-    assert fsdp_leaf_pspec((Key("params"), Key("kernel")),
-                           np.zeros((64, 32)), 8) is None
-    assert fsdp_leaf_pspec((Key("opt_state"), Key("bias")),
-                           np.zeros((6,)), 8) is None
-
-    sh = state_shardings(
-        {"opt_state": {"m": np.zeros((64, 4))},
-         "params": {"w": np.zeros((64, 4))}}, mesh8, fsdp=True)
-    assert sh["opt_state"]["m"].spec == P(DATA_AXIS, None)
+    plan = build_plan(mesh8, zero1=True)
+    state = {
+        "opt_state": {"mu": np.zeros((64,)),        # flat, divisible
+                      "odd": np.zeros((6,)),        # 1-D but not % 8
+                      "kernel": np.zeros((8, 8))},  # not flat
+        "target_params": {"w": np.zeros((128,))},
+        "params": {"w": np.zeros((64,))},           # forward-critical
+    }
+    sh = plan.state_sharding(state)
+    assert sh["opt_state"]["mu"].spec == P(DATA_AXIS)
+    assert sh["target_params"]["w"].spec == P(DATA_AXIS)
+    assert sh["opt_state"]["odd"].spec == P()
+    assert sh["opt_state"]["kernel"].spec == P()
     assert sh["params"]["w"].spec == P()
+    # replicated plan: identity with the base rules
+    off = build_plan(mesh8, zero1=False).state_sharding(state)
+    assert all(s.spec == P() for s in jax.tree_util.tree_leaves(off))
 
 
-@pytest.mark.slow
-def test_fsdp_train_step_matches_dp_numerics():
-    """FSDP is a layout choice, not a numerics choice: the same batch must
-    produce the same loss as the fully-replicated layout, with the aux
-    state actually sharded over the data axis."""
-    from byol_tpu.parallel.mesh import DATA_AXIS
+def test_zero1_rejects_tensor_parallel(mesh8):
+    """ZeRO-1's flat layout would clobber the TP 'model'-axis opt-state
+    sharding — rejected at plan build (and at config resolve())."""
+    from byol_tpu.parallel.compile_plan import build_plan
     devices = jax.devices()[:8]
-    mesh = build_mesh(MeshSpec(data=8), devices)
-    _, (_, state_dp, step_dp, _, _) = _setup(mesh, data=8)
-    _, (_, state_fs, step_fs, _, _) = _setup(mesh, data=8, fsdp=True)
-
-    sharded = [leaf for leaf in
-               jax.tree_util.tree_leaves(state_fs.opt_state)
-               if hasattr(leaf, "sharding")
-               and DATA_AXIS in str(leaf.sharding.spec)]
-    assert sharded, "no optimizer-state leaf is data-sharded under fsdp"
-    assert all(P() == leaf.sharding.spec for leaf in
-               jax.tree_util.tree_leaves(state_fs.params))
-
-    b = _batch(mesh, 16, seed=5)
-    b2 = _batch(mesh, 16, seed=5)
-    _, m_dp = step_dp(state_dp, b)
-    _, m_fs = step_fs(state_fs, b2)
-    np.testing.assert_allclose(float(m_dp["loss_mean"]),
-                               float(m_fs["loss_mean"]), rtol=2e-4)
+    mesh_tp = build_mesh(MeshSpec(data=4, model=2), devices)
+    with pytest.raises(ValueError, match="model_parallel"):
+        build_plan(mesh_tp, zero1=True)
+    with pytest.raises(ValueError, match="model-parallel"):
+        _setup(mesh8, data=4, model=2, zero1="on")
 
 
 @pytest.mark.slow

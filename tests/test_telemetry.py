@@ -448,6 +448,29 @@ class TestRunLog:
             log.emit("epoch", epoch=0, split="train")  # no metrics
         log.close()
 
+    def test_run_header_sharding_plan_validation(self, tmp_path):
+        """ISSUE 7: the optional run_header.sharding_plan field must carry
+        the full CompilePlan.describe() provenance or be rejected — a run
+        log must never claim a plan it cannot name."""
+        plan = {"mesh_shape": {"data": 8}, "axis_names": ["data"],
+                "zero1": "on", "donate_argnums": {"train_step": [0]}}
+        p = str(tmp_path / "r.jsonl")
+        with events_lib.RunLog(p) as log:
+            log.emit("run_header", config={}, jax_version="0",
+                     backend="cpu", sharding_plan=plan)   # valid: accepted
+            with pytest.raises(ValueError, match="sharding_plan"):
+                log.emit("run_header", config={}, jax_version="0",
+                         backend="cpu", sharding_plan={"zero1": "on"})
+            with pytest.raises(ValueError, match="zero1"):
+                bad = dict(plan, zero1=True)   # must be the 'off'|'on' str
+                log.emit("run_header", config={}, jax_version="0",
+                         backend="cpu", sharding_plan=bad)
+            with pytest.raises(ValueError, match="sharding_plan"):
+                log.emit("run_header", config={}, jax_version="0",
+                         backend="cpu", sharding_plan=["not", "a", "dict"])
+        (e,) = events_lib.read_events(p)
+        assert e["sharding_plan"] == plan
+
     def test_reader_rejects_corrupt_and_drifted_lines(self, tmp_path):
         p = tmp_path / "r.jsonl"
         with events_lib.RunLog(str(p)) as log:

@@ -283,6 +283,23 @@ def test_cli_parser_reference_surface(tmp_path):
     assert cfg.parity.schedule_granularity == "epoch"
 
 
+def test_cli_zero1_flag_and_fsdp_alias():
+    """ISSUE 7: --zero1 {off,on} is the weight-update-sharding switch;
+    the pre-ZeRO-1 --fsdp spelling survives as a deprecated alias."""
+    assert config_from_args(build_parser().parse_args([])).device.zero1 \
+        == "off"
+    args = build_parser().parse_args(["--zero1", "on"])
+    assert config_from_args(args).device.zero1 == "on"
+    args = build_parser().parse_args(["--fsdp"])
+    assert config_from_args(args).device.zero1 == "on"
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--zero1", "sharded"])
+    # the alias must not silently override an EXPLICIT --zero1 off
+    args = build_parser().parse_args(["--fsdp", "--zero1", "off"])
+    with pytest.raises(SystemExit, match="conflicts"):
+        config_from_args(args)
+
+
 def test_preflight_cpu_pinned_skips_probe(monkeypatch):
     """Under an explicit cpu pin (the test conftest) there is nothing to
     probe — no subprocess may be spawned."""

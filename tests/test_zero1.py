@@ -1,0 +1,279 @@
+"""ZeRO-1 weight-update sharding (ISSUE 7 tentpole).
+
+The contracts under test:
+
+- **Parity** (acceptance): on the 8-virtual-device CPU mesh, ``--zero1 on``
+  matches the replicated step's loss and post-step params / LARS momentum /
+  EMA target to tight tolerance at accum 1 AND accum 2, with every step
+  running under the ``guard_steps`` transfer-guard fixture (an implicit
+  host sync inside the shard/gather plumbing fails here, on CPU).  The
+  flat layout is numerics-preserving by construction — zero padding maps
+  through the whole update chain as zeros and leaves every per-leaf l2
+  norm (LARS trust ratios) unchanged (parallel/zero1.py docstring).
+- **Off-identity** (acceptance): ``--zero1 off`` lowers byte-identical HLO
+  to the pre-plan per-site jit wiring — the compile plan is a refactor of
+  WHERE shardings are declared, not of the default program.
+- **Layout**: under ZeRO-1 the momentum/EMA leaves really are flat (1-D)
+  and sharded over ``data``; params stay replicated for the forward.
+- The flat-layout helpers round-trip exactly, and ``CompilePlan.describe()``
+  emits the JSON-serializable ``sharding_plan`` record the run-log header
+  carries (observability/events.py validates it).
+"""
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from byol_tpu.core import config as config_lib
+from byol_tpu.parallel import zero1 as zero1_lib
+from byol_tpu.parallel.compile_plan import build_plan
+from byol_tpu.parallel.mesh import DATA_AXIS, shard_batch_to_mesh
+from byol_tpu.training.build import setup_training
+from tests.conftest import guard_steps
+
+BATCH = 16
+IMAGE = 16
+
+
+def _rcfg(zero1="off", accum=1):
+    c = config_lib.Config()
+    c = c.replace(
+        task=dataclasses.replace(c.task, batch_size=BATCH, epochs=2,
+                                 image_size_override=IMAGE),
+        model=dataclasses.replace(c.model, arch="resnet18",
+                                  head_latent_size=32, projection_size=16),
+        optim=dataclasses.replace(c.optim, warmup=1, lr=0.1,
+                                  accum_steps=accum),
+        device=dataclasses.replace(c.device, num_replicas=8, half=False,
+                                   zero1=zero1),
+    )
+    return config_lib.resolve(c, num_train_samples=64, num_test_samples=16,
+                              output_size=10, input_shape=(IMAGE, IMAGE, 3),
+                              representation_size=512)
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "view1": rng.rand(BATCH, IMAGE, IMAGE, 3).astype(np.float32),
+        "view2": rng.rand(BATCH, IMAGE, IMAGE, 3).astype(np.float32),
+        "label": rng.randint(0, 10, size=(BATCH,)).astype(np.int32),
+    }
+
+
+def _run_arm(mesh, zero1, accum, n=3):
+    """n guarded train steps + one guarded eval from the seed-0 init.
+
+    Returns (plan, plan-layout state, CANONICAL state, train metrics,
+    eval loss) — the canonical view (plan.to_canonical) is what parity
+    compares, since the ZeRO-1 arm's momentum/EMA live flat-sharded."""
+    rcfg = _rcfg(zero1=zero1, accum=accum)
+    plan = build_plan(mesh, zero1=(zero1 == "on"))
+    net, state, train_step, eval_step, _ = setup_training(
+        rcfg, mesh, jax.random.PRNGKey(0), plan=plan)
+    train_step = guard_steps(train_step)
+    metrics = None
+    for i in range(n):
+        batch = shard_batch_to_mesh(_batch(seed=i), mesh)
+        state, metrics = train_step(state, batch)
+    eval_batch = shard_batch_to_mesh(_batch(seed=99), mesh)
+    ev = guard_steps(eval_step)(state, eval_batch)
+    return (plan, state, plan.to_canonical(state),
+            {k: float(v) for k, v in metrics.items()},
+            float(ev["loss_mean"]))
+
+
+def _tree_maxdiff(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    diffs = [float(np.max(np.abs(np.asarray(x, np.float32)
+                                 - np.asarray(y, np.float32))))
+             if np.asarray(x).size else 0.0
+             for x, y in zip(la, lb)]
+    return max(diffs)
+
+
+# ---------------------------------------------------------------------------
+# parity: zero1 on == replicated, accum 1 and 2  (acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("accum", [1, 2])
+def test_zero1_matches_replicated(mesh8, accum):
+    assert len(mesh8.devices.flat) >= 4      # acceptance: >= 4-device mesh
+    plan_off, _, canon_off, m_off, ev_off = _run_arm(mesh8, "off", accum)
+    plan_on, raw_on, canon_on, m_on, ev_on = _run_arm(mesh8, "on", accum)
+
+    # the ZeRO-1 arm really shards: flat momentum/EMA leaves over 'data'
+    flat_sharded = [
+        leaf for leaf in jax.tree_util.tree_leaves(
+            (raw_on.opt_state, raw_on.target_params))
+        if getattr(leaf, "ndim", 0) == 1
+        and DATA_AXIS in str(leaf.sharding.spec)]
+    assert flat_sharded, "no momentum/EMA leaf is flat-sharded over data"
+    # params stay replicated for the forward
+    assert all(leaf.sharding.spec == P() for leaf in
+               jax.tree_util.tree_leaves(raw_on.params))
+
+    # loss identical arm-to-arm (same batches, same math)
+    for k in m_off:
+        np.testing.assert_allclose(m_on[k], m_off[k], rtol=1e-5,
+                                   err_msg=f"metric {k} @ accum {accum}")
+    np.testing.assert_allclose(ev_on, ev_off, rtol=1e-5)
+
+    # post-step state: params / LARS momentum / EMA target, canonical view
+    assert _tree_maxdiff(canon_off.params, canon_on.params) < 1e-5
+    assert _tree_maxdiff(canon_off.target_params,
+                         canon_on.target_params) < 1e-5
+    assert _tree_maxdiff(canon_off.opt_state, canon_on.opt_state) < 1e-5
+    assert int(canon_on.step) == int(canon_off.step) == 3
+    assert int(canon_on.ema_step) == int(canon_off.ema_step) == 3
+
+
+# ---------------------------------------------------------------------------
+# --zero1 off HLO identity with the pre-plan wiring  (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_zero1_off_lowers_pre_plan_hlo(mesh8):
+    """The compile plan with zero1 off must lower the EXACT program the
+    old per-site ``jax.jit`` wiring in training/build.py produced — same
+    fn, same shardings, same donation, byte-identical text."""
+    from byol_tpu.core.precision import get_policy
+    from byol_tpu.parallel.partitioning import state_shardings
+    from byol_tpu.training.build import build_net, build_tx, step_config
+    from byol_tpu.training.steps import make_train_step
+
+    rcfg = _rcfg()
+    plan = build_plan(mesh8, zero1=False)
+    net, state, train_step, _, _ = setup_training(
+        rcfg, mesh8, jax.random.PRNGKey(0), plan=plan)
+    batch = shard_batch_to_mesh(_batch(), mesh8)
+    with mesh8:
+        plan_text = train_step.__wrapped__.lower(state, batch).as_text()
+
+    # the pre-plan construction, reconstructed inline (what build.py's
+    # setup_training spelled before the compile plan owned the wiring)
+    pre_step = jax.jit(
+        make_train_step(build_net(rcfg), build_tx(rcfg)[0],
+                        step_config(rcfg), get_policy(False)),
+        in_shardings=(state_shardings(state, mesh8),
+                      NamedSharding(mesh8, P(DATA_AXIS))),
+        out_shardings=(state_shardings(state, mesh8),
+                       NamedSharding(mesh8, P())),
+        donate_argnums=(0,))
+    with mesh8:
+        pre_text = pre_step.lower(state, batch).as_text()
+    assert plan_text == pre_text
+
+
+def test_zero1_on_lowers_a_different_program(mesh8):
+    """The gate is live: zero1 on traces the shard/gather program (a
+    no-op flag would vacuously pass the identity test above)."""
+    off = _rcfg("off")
+    on = _rcfg("on")
+    texts = {}
+    for rcfg, z in ((off, False), (on, True)):
+        plan = build_plan(mesh8, zero1=z)
+        _, state, train_step, _, _ = setup_training(
+            rcfg, mesh8, jax.random.PRNGKey(0), plan=plan)
+        batch = shard_batch_to_mesh(_batch(), mesh8)
+        with mesh8:
+            texts[z] = train_step.__wrapped__.lower(state, batch).as_text()
+    assert texts[True] != texts[False]
+
+
+# ---------------------------------------------------------------------------
+# flat-layout helpers
+# ---------------------------------------------------------------------------
+
+class TestFlatLayout:
+    def test_padded_size(self):
+        assert zero1_lib.padded_size(8, 4) == 8
+        assert zero1_lib.padded_size(9, 4) == 12
+        assert zero1_lib.padded_size(1, 8) == 8
+        assert zero1_lib.padded_size(0, 8) == 0
+
+    @pytest.mark.parametrize("shape", [(), (5,), (3, 7), (2, 3, 4)])
+    def test_flatten_unflatten_roundtrip(self, shape):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(np.asarray(rng.rand(*shape), np.float32))
+        flat = zero1_lib.flatten_leaf(x, 8)
+        assert flat.ndim == 1 and flat.size % 8 == 0
+        # the padding is zeros (the invariance the update chain relies on)
+        n_real = int(np.prod(shape)) if shape else 1
+        np.testing.assert_array_equal(np.asarray(flat[n_real:]), 0.0)
+        tmpl = jax.ShapeDtypeStruct(shape, x.dtype)
+        back = zero1_lib.unflatten_leaf(flat, tmpl)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+        assert zero1_lib.flat_struct(tmpl, 8).shape == flat.shape
+
+    def test_to_layout_both_directions_and_passthrough(self):
+        tree = {"k": jnp.arange(6.0).reshape(2, 3),
+                "count": jnp.zeros((), jnp.int32)}
+        canon_tmpl = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+        flat_tmpl = jax.tree_util.tree_map(
+            lambda t: (zero1_lib.flat_struct(t, 4)
+                       if t.shape else t), canon_tmpl)
+        flat = zero1_lib.to_layout(tree, flat_tmpl, 4)
+        assert flat["k"].shape == (8,)
+        assert flat["count"].shape == ()          # scalar passes through
+        back = zero1_lib.to_layout(flat, canon_tmpl, 4)
+        np.testing.assert_array_equal(np.asarray(back["k"]),
+                                      np.asarray(tree["k"]))
+
+    def test_to_layout_1d_nondivisible_leaf_roundtrips(self):
+        """A canonical leaf that is ITSELF 1-D and non-divisible (the
+        probe bias: size 10 under 8 shards -> flat (16,)) must round-trip
+        — rank alone cannot pick the conversion direction (regression:
+        flat->canonical misread the (10,) template as a flatten target)."""
+        bias = jnp.arange(10.0)
+        canon_tmpl = {"b": jax.ShapeDtypeStruct((10,), bias.dtype)}
+        flat_tmpl = {"b": zero1_lib.flat_struct(canon_tmpl["b"], 8)}
+        assert flat_tmpl["b"].shape == (16,)
+        flat = zero1_lib.to_layout({"b": bias}, flat_tmpl, 8)
+        assert flat["b"].shape == (16,)
+        back = zero1_lib.to_layout(flat, canon_tmpl, 8)
+        np.testing.assert_array_equal(np.asarray(back["b"]),
+                                      np.asarray(bias))
+
+    def test_to_layout_rejects_impossible_conversion(self):
+        bad_tmpl = {"k": jax.ShapeDtypeStruct((5,), jnp.float32)}
+        with pytest.raises(ValueError, match="layout conversion"):
+            zero1_lib.to_layout({"k": jnp.zeros((2, 3))}, bad_tmpl, 4)
+
+
+# ---------------------------------------------------------------------------
+# plan provenance: the run-header sharding_plan record
+# ---------------------------------------------------------------------------
+
+def test_plan_describe_is_the_run_header_record(mesh8):
+    d = build_plan(mesh8, zero1=True).describe()
+    assert d["mesh_shape"] == {"data": 8, "sequence": 1, "model": 1}
+    assert d["axis_names"] == ["data", "sequence", "model"]
+    assert d["zero1"] == "on"
+    assert d["donate_argnums"]["train_step"] == [0]
+    assert set(d["donate_argnums"]) == {
+        "train_step", "eval_step", "encoder_extractor", "spmd_extractor"}
+    json.dumps(d)                       # header-embeddable as-is
+    assert build_plan(mesh8).describe()["zero1"] == "off"
+
+
+def test_zero1_context_requires_prepare_state(mesh8):
+    with pytest.raises(ValueError, match="prepare_state"):
+        build_plan(mesh8, zero1=True).zero1_context()
+
+
+def test_codec_requires_prepare_state(mesh8):
+    """The checkpoint codec fails with the same explicit error as
+    zero1_context on an unprepared plan — not a NoneType TypeError deep
+    inside _convert."""
+    state = {"opt_state": jnp.zeros((4,))}
+    for method in ("to_canonical", "from_canonical", "canonical_template"):
+        plan = build_plan(mesh8, zero1=True)
+        with pytest.raises(ValueError, match="prepare_state"):
+            getattr(plan, method)(state)

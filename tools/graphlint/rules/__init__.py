@@ -10,8 +10,10 @@ from tools.graphlint.rules.host_sync import HostSyncRule
 from tools.graphlint.rules.prng import PRNGReuseRule
 from tools.graphlint.rules.recompile import RecompileRule
 from tools.graphlint.rules.remat_tags import RematTagRule
+from tools.graphlint.rules.sharding_axes import ShardingAxesRule
 
 
 def all_rules() -> List[Rule]:
     return [HostSyncRule(), RecompileRule(), PRNGReuseRule(),
-            DonateRule(), RematTagRule(), CliDriftRule()]
+            DonateRule(), RematTagRule(), CliDriftRule(),
+            ShardingAxesRule()]

@@ -1,0 +1,163 @@
+"""GL107 — sharding-spec drift vs the declared mesh axes / compile plan.
+
+Two hazards, both silent at runtime until a pod run wedges or quietly
+replicates what the author believed was sharded:
+
+1. **Undeclared axis names.**  A ``PartitionSpec`` naming a mesh axis the
+   parallel/ modules never declared (``P('modle')`` for ``'model'``) does
+   not error at trace time in every path — with ``AUTO``/unconstrained
+   sharding it can silently fall back to replication, and inside a
+   ``shard_map``/``with_sharding_constraint`` it fails only when the mesh
+   is finally bound, far from the typo.  The declared-axis vocabulary is
+   collected from module-level ``*_AXIS = "name"`` string constants and
+   ``AXIS_NAMES = (...)`` tuples (parallel/mesh.py is the shipped
+   declarer); spec strings must resolve into it.  References through the
+   imported constants (``P(DATA_AXIS)``) are declared by construction —
+   they cannot drift — so only resolvable string literals are judged, and
+   when the lint set declares no axes at all the check stands down (a
+   partial ``--select`` sweep of one file must not guess).
+
+2. **Sharding decisions outside the compile plan.**  The compile plan
+   (parallel/compile_plan.py) is the one module that owns ``in_shardings``
+   / ``out_shardings`` / ``donate_argnums`` for every jitted entry point
+   (ISSUE 7 tentpole); a ``jax.jit(..., in_shardings=...)`` anywhere else
+   reintroduces exactly the per-site drift the plan exists to end — two
+   call sites disagreeing about the state layout compile fine and produce
+   a resharding collective per step.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tools.graphlint.astutil import (module_str_constants, qualname)
+from tools.graphlint.engine import Context, Finding, LintedFile, Rule
+
+# jit-family callables whose sharding kwargs must live in the plan module.
+_JIT_QUALS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_SHARDING_KWARGS = ("in_shardings", "out_shardings")
+# the one module allowed to pass them (path compared with / separators)
+_PLAN_SUFFIX = "parallel/compile_plan.py"
+
+_PSPEC_TAIL = "PartitionSpec"
+
+
+class _Store:
+    def __init__(self) -> None:
+        # axis value -> (file, line) of its declaration
+        self.axes: Dict[str, Tuple[str, int]] = {}
+        # constant NAMES that declare axes (DATA_AXIS, ...) — an imported
+        # reference to one of these is declared by construction
+        self.const_names: Set[str] = set()
+
+
+def _store(ctx: Context) -> _Store:
+    return ctx.store.setdefault("sharding_axes", _Store())
+
+
+def _is_pspec_call(node: ast.Call, f: LintedFile) -> bool:
+    q = qualname(node.func, f.imports)
+    return bool(q) and (q == _PSPEC_TAIL or q.endswith("." + _PSPEC_TAIL))
+
+
+class ShardingAxesRule(Rule):
+    id = "GL107"
+    name = "sharding-axis-drift"
+    doc = ("PartitionSpec axis names must be declared by the parallel/ "
+           "modules; jit sharding kwargs belong to the compile plan")
+
+    # ------------------------------------------------------------- phase 1
+    def collect(self, f: LintedFile, ctx: Context) -> None:
+        st = _store(ctx)
+        consts = module_str_constants(f.tree)
+        # bare *_AXIS constants declare only inside the parallel/ package
+        # (mesh.py is the shipped declarer); elsewhere a stray FOO_AXIS
+        # string must not silently grow the vocabulary — the canonical
+        # cross-module declaration is the AXIS_NAMES tuple below
+        if "parallel/" in f.rel.replace("\\", "/"):
+            for name, value in consts.items():
+                if name.endswith("_AXIS"):
+                    st.axes.setdefault(value, (f.rel, 0))
+                    st.const_names.add(name)
+        # AXIS_NAMES = (DATA_AXIS, SEQUENCE_AXIS, ...) — names or literals
+        for stmt in f.tree.body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "AXIS_NAMES"
+                    and isinstance(stmt.value, (ast.Tuple, ast.List))):
+                continue
+            for e in stmt.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    st.axes.setdefault(e.value, (f.rel, stmt.lineno))
+                elif isinstance(e, ast.Name) and e.id in consts:
+                    st.axes.setdefault(consts[e.id], (f.rel, stmt.lineno))
+                    st.const_names.add(e.id)
+
+    # ------------------------------------------------------------- phase 2
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        st = _store(ctx)
+        findings: List[Finding] = []
+        consts = module_str_constants(f.tree)
+        rel = f.rel.replace("\\", "/")
+
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+
+            # (2) sharding kwargs outside the compile plan
+            q = qualname(node.func, f.imports)
+            jit_like = q in _JIT_QUALS
+            if (not jit_like and q == "functools.partial" and node.args):
+                jit_like = qualname(node.args[0],
+                                    f.imports) in _JIT_QUALS
+            if jit_like and not rel.endswith(_PLAN_SUFFIX):
+                for kw in node.keywords:
+                    if kw.arg in _SHARDING_KWARGS:
+                        findings.append(self.finding(
+                            f, node, f"jit call passes {kw.arg}= outside "
+                            f"the compile plan ({_PLAN_SUFFIX}) — all "
+                            "entry-point shardings are declared there "
+                            "(ISSUE 7); an inline spec here can silently "
+                            "disagree with the plan's state layout"))
+
+            # (1) axis names inside PartitionSpec(...) calls
+            if not _is_pspec_call(node, f) or not st.axes:
+                continue
+            operands = list(node.args)
+            for kw in node.keywords:
+                operands.append(kw.value)
+            flat: List[ast.AST] = []
+            for op in operands:
+                if isinstance(op, (ast.Tuple, ast.List)):
+                    flat.extend(op.elts)      # P(('data', 'model'), None)
+                else:
+                    flat.append(op)
+            for op in flat:
+                if isinstance(op, ast.Constant) and op.value is None:
+                    continue
+                if isinstance(op, ast.Name):
+                    if op.id in consts:
+                        # module-level string constant: resolvable — judge
+                        # its VALUE against the declared vocabulary
+                        axis = consts[op.id]
+                        if axis in st.axes:
+                            continue
+                    else:
+                        # an imported *_AXIS constant is declared by
+                        # construction (it IS the declaration); any other
+                        # name is unresolvable — stand down rather than
+                        # guess (zero-false-positive contract)
+                        continue
+                elif isinstance(op, ast.Constant) and isinstance(op.value,
+                                                                 str):
+                    axis = op.value
+                    if axis in st.axes:
+                        continue
+                else:
+                    continue              # starred/derived spec: can't judge
+                declared = sorted(st.axes)
+                findings.append(self.finding(
+                    f, node, f"PartitionSpec names mesh axis {axis!r}, "
+                    f"which no parallel/ module declares (declared: "
+                    f"{declared}) — the spec silently misses its axis"))
+        return findings
