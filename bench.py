@@ -558,17 +558,8 @@ def main():
     # tunnel cannot race the override into a mixed backend.
     n_cpu = _int_flag("--cpu-devices", 0)
     if n_cpu:
-        jax.config.update("jax_platforms", "cpu")
-        try:
-            jax.config.update("jax_num_cpu_devices", n_cpu)
-        except AttributeError:
-            # pre-0.4.38 jax: the XLA_FLAGS spelling does the same job as
-            # long as the backend is still uninitialized (tests/conftest.py
-            # uses the identical fallback)
-            os.environ["XLA_FLAGS"] = (
-                os.environ.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={n_cpu}"
-            ).strip()
+        from byol_tpu.core.preflight import force_cpu_devices
+        force_cpu_devices(n_cpu)
     # Optional arch override (e.g. --arch vit_b16, the BASELINE.json
     # config-5 encoder swap).  Non-default archs measure into their OWN
     # partial file so they can never rotate away the committed resnet50
@@ -618,7 +609,7 @@ def main():
     if not _preflight_backend():
         mode = {"--sweep", "--profile", "--stem-ab", "--mvc",
                 "--accum-ladder", "--dry-compile", "--input-ladder",
-                "--telemetry-ab", "--zero1-ab"} \
+                "--telemetry-ab", "--zero1-ab", "--serve-ladder"} \
             & set(sys.argv[1:])
         if mode:
             # only the headline has a committed artifact to fall back to;
@@ -751,6 +742,9 @@ def main():
         return
     if "--zero1-ab" in sys.argv[1:]:
         _zero1_ab(arch, image_size, on_tpu, attn_impl)
+        return
+    if "--serve-ladder" in sys.argv[1:]:
+        _serve_ladder(arch, image_size, on_tpu, attn_impl)
         return
 
     value = best_throughput("tpu_first", half=True, fuse_views=True,
@@ -1636,6 +1630,133 @@ def _zero1_ab(arch, image_size, on_tpu, attn_impl):
         "effective_batch_per_chip": eff, "microbatch_per_chip": mb,
         "accum_steps": accum, "remat_policy": policy,
         "device_kind": jax.devices()[0].device_kind,
+    }))
+
+
+def _serve_ladder(arch, image_size, on_tpu, attn_impl):
+    """Serve ladder (``--serve-ladder``): latency vs throughput for the
+    embedding service (byol_tpu/serving/) at 1/8/64 concurrent synthetic
+    client streams.
+
+    Each rung drives a closed-loop budget of single-image requests through
+    the FULL serving stack — bounded queue, request coalescing, bucket
+    padding, pinned-host staging, AOT embed, readback — and records the
+    request-latency tail (p50/p99 ms), achieved rows/sec, batch fill
+    ratio, and the engine compile counter.  The counter column is the
+    zero-recompile contract made visible: after the warmup phase it must
+    not move, or a rung's latency includes XLA compiles (the GL102 hazard
+    on the latency path) and the row says so.
+
+    CPU-runnable with ``--cpu-devices N`` (random-init encoder — latency
+    is independent of parameter values); on TPU the same command measures
+    the real serving config.  Knobs: ``--serve-streams 1,8,64``,
+    ``--serve-requests <budget/rung>``, ``--serve-max-batch``,
+    ``--serve-min-bucket``, ``--serve-wait-ms``.
+    """
+    import time
+
+    from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
+                                      TaskConfig)
+    from byol_tpu.parallel.mesh import MeshSpec, build_mesh
+    from byol_tpu.serving.cli import _synthetic_clients
+    from byol_tpu.serving.service import ServeConfig, build_service
+
+    streams_list = [int(s) for s in
+                    _str_flag("--serve-streams", "1,8,64").split(",")]
+    budget = _int_flag("--serve-requests", 2048 if on_tpu else 256)
+    max_batch = _int_flag("--serve-max-batch", 64)
+    n_dev = len(jax.devices())
+    if n_dev & (n_dev - 1):
+        # fail fast with the actionable constraint, not a BucketSpec /
+        # engine divisibility error after the model is already built:
+        # buckets are powers of two and shard their rows over the mesh
+        raise SystemExit(
+            f"bench: --serve-ladder needs a power-of-two device count "
+            f"(got {n_dev}): bucket shapes are powers of two and must "
+            "shard evenly over the data axis; pass --cpu-devices 2|4|8|...")
+    min_bucket = _int_flag("--serve-min-bucket", max(8, n_dev))
+    if min_bucket > max_batch:
+        raise SystemExit(
+            f"bench: serve min bucket {min_bucket} (default max(8, "
+            f"n_devices)) exceeds --serve-max-batch {max_batch}; raise "
+            "the max batch or lower --serve-min-bucket")
+    wait_ms = float(_str_flag("--serve-wait-ms", "5.0"))
+    half = bool(on_tpu)      # bf16 embed on real silicon, fp32 on CPU
+
+    mesh = build_mesh(MeshSpec(data=n_dev))
+    cfg = Config(
+        task=TaskConfig(task="fake", batch_size=max(max_batch, n_dev),
+                        epochs=1, image_size_override=image_size),
+        model=ModelConfig(arch=arch),
+        device=DeviceConfig(num_replicas=n_dev, half=half),
+    )
+    serve_cfg = ServeConfig(min_bucket=min_bucket, max_bucket=max_batch,
+                            max_wait_ms=wait_ms,
+                            stats_interval_s=1e9)   # rows emit explicitly
+    service = build_service(cfg, serve_cfg, mesh=mesh)
+    t0 = time.perf_counter()
+    service.start()           # AOT-compiles the whole bucket vocabulary
+    warm_compiles = service.engine.compile_count
+    warmup_s = time.perf_counter() - t0
+    print(f"bench: serve warmup: {warm_compiles} bucket programs "
+          f"{list(service.engine.buckets.sizes)} in {warmup_s:.1f}s",
+          file=sys.stderr)
+    shape = service.engine.input_shape
+    ladder = []
+    try:
+        for n_streams in streams_list:
+            # untimed warm pass: first execution of each bucket program
+            # pays one-time backend setup that is not steady-state latency
+            _synthetic_clients(service, max(2 * n_streams, 8), n_streams,
+                               shape, seed=17)
+            service.meter.snapshot(time.perf_counter())   # reset window
+            rung_base = service.engine.compile_count  # per-rung baseline:
+            t1 = time.perf_counter()                  # a compile counts in
+            done = _synthetic_clients(service, budget, n_streams, shape,
+                                      seed=n_streams)  # the rung it ran in
+            elapsed = time.perf_counter() - t1
+            recompiles = service.engine.compile_count - rung_base
+            # one serve_stats event per rung next to the bench_row — the
+            # serving schema exercised by the same capture CI validates
+            snap = service.meter.emit(
+                _events, time.perf_counter(), streams=n_streams,
+                compile_count=service.engine.compile_count)
+            row = {
+                "streams": n_streams, "requests": done,
+                "p50_ms": round(snap["p50_ms"], 3),
+                "p99_ms": round(snap["p99_ms"], 3),
+                "mean_ms": round(snap["mean_ms"], 3),
+                "throughput_img_per_sec": round(done / elapsed, 2),
+                "throughput_img_per_sec_per_chip":
+                    round(done / elapsed / n_dev, 2),
+                "fill_ratio": round(snap["fill_ratio"], 4),
+                "queue_depth": round(snap["queue_depth"], 2),
+                "batches": int(snap["batches"]),
+                "recompiles_after_warmup": recompiles,
+                "max_batch": max_batch, "min_bucket": min_bucket,
+                "max_wait_ms": wait_ms, "n_devices": n_dev,
+                "half": half, "warmup_compile_seconds": round(warmup_s, 2),
+            }
+            ladder.append(row)
+            _record(f"serve_s{n_streams}", fit=True, **row)
+            print(f"bench: serve s{n_streams}: p50 {row['p50_ms']}ms "
+                  f"p99 {row['p99_ms']}ms "
+                  f"{row['throughput_img_per_sec']} img/s "
+                  f"fill {row['fill_ratio']} "
+                  f"recompiles {recompiles}", file=sys.stderr)
+    finally:
+        service.stop()
+    print(json.dumps({
+        "metric": "serve_ladder_p99_ms",
+        "value": ladder[-1]["p99_ms"] if ladder else None,
+        "unit": "ms @ most-concurrent rung",
+        "vs_baseline": None,
+        "arch": arch, "image_size": image_size,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": n_dev,
+        "recompiles_after_warmup": sum(r["recompiles_after_warmup"]
+                                       for r in ladder),
+        "rows": ladder,
     }))
 
 

@@ -1,4 +1,21 @@
-from byol_tpu.cli import main
+"""``python -m byol_tpu [serve] ...`` — train by default, serve on demand.
+
+Subcommand dispatch lives here (not in cli.py) so the training surface
+keeps its reference-mirroring flag-only interface: ``python -m byol_tpu
+--task cifar10 ...`` trains exactly as before, ``python -m byol_tpu serve
+--checkpoint ...`` stands up the embedding service (byol_tpu/serving/).
+"""
+import sys
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        from byol_tpu.serving.cli import main as serve_main
+        return serve_main(argv[1:])
+    from byol_tpu.cli import main as train_main
+    return train_main(argv)
+
 
 if __name__ == "__main__":
     raise SystemExit(main())

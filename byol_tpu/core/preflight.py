@@ -63,3 +63,24 @@ def preflight_backend(timeout_s: float = 180.0) -> bool:
               "jax silently fell back.", file=sys.stderr)
         return False
     return True
+
+
+def force_cpu_devices(n: int) -> None:
+    """Pin the CPU platform and size an N-device virtual mesh — the
+    ``--cpu-devices N`` semantics shared by bench.py and the serve CLI
+    (tests/conftest.py performs the same dance inline: it must run
+    before this package imports).
+
+    Must be called before anything initializes the XLA backend; forcing
+    the platform first means a half-up TPU tunnel cannot race the
+    override into a mixed backend.  The XLA_FLAGS spelling is the
+    pre-0.4.38 fallback for jax builds without ``jax_num_cpu_devices``.
+    """
+    import os
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}").strip()

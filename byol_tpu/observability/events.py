@@ -50,6 +50,11 @@ EVENT_KINDS: Dict[str, tuple] = {
     "halt": ("step", "reason"),
     "state_dump": ("step",),
     "bench_row": ("config",),
+    # serving/meter.py window snapshot: request count, coalesced-batch
+    # count, and the latency tail — the serving analog of "step"/"epoch".
+    # Additive kind (no SCHEMA_VERSION bump); optional payload carries
+    # fill ratio, queue depth, and the engine compile counter.
+    "serve_stats": ("requests", "batches", "p50_ms", "p99_ms"),
     "run_end": (),
 }
 

@@ -10,10 +10,11 @@ answer is declared data in ONE place:
 - the :class:`CompilePlan` owns the mesh, the ``NamedSharding`` for every
   pytree the program moves (train state, batches, metrics/health outputs,
   extractor features), and the jit wiring — in/out shardings + donation —
-  for every jitted entry point: the train step, the eval step, and both
+  for every jitted entry point: the train step, the eval step, both
   linear-eval feature extractors (the bench ``--dry-compile`` path reuses
   the train step via ``setup_training``, so it is covered by
-  construction);
+  construction), and the serving embed step (serving/engine.py AOT-lowers
+  it per bucket shape);
 - ZeRO-1 weight-update sharding (``--zero1 on``; parallel/zero1.py) is a
   property of the plan, not of the step code: the plan converts the state
   to the flat leaf-partitioned layout, assigns ``P(data)`` to the LARS
@@ -48,6 +49,8 @@ DONATE = {
     "eval_step": (),          # state is read-only across eval batches
     "encoder_extractor": (),
     "spmd_extractor": (),
+    "serve_step": (0,),       # the staged request batch is consumed: its
+                              # HBM buffer is free for the embeddings
 }
 
 
@@ -164,7 +167,7 @@ class CompilePlan:
         return Zero1Context(mesh=self.mesh, num_shards=self.num_shards,
                             param_template=self._param_template)
 
-    # -- jit wiring: the five entry points ---------------------------------
+    # -- jit wiring: the six entry points ----------------------------------
     def jit_train_step(self, fn: Callable, state_sharding: Any):
         """(state, batch) -> (state, metrics): state in plan layout (donated),
         batch over ``data``, metrics (incl. the telemetry health vector)
@@ -188,6 +191,28 @@ class CompilePlan:
         multi-host linear-eval extraction (linear_eval.py)."""
         rep = self.replicated
         return jax.jit(fn, out_shardings=(rep, rep, rep))
+
+    def jit_serve_step(self, fn: Callable):
+        """The serving hot path (serving/engine.py): ``x -> embeddings``.
+
+        The staged request batch is sharded over ``data`` (every chip
+        encodes its slice of the coalesced batch), embeddings come back
+        REPLICATED — the out_shardings is the gather the host reads one
+        contiguous fp32 array from.  The input buffer is donated: a
+        serving process runs this step forever, and the request staging
+        buffer's HBM is dead the moment the forward has consumed it.
+
+        Returns the UNCOMPILED jit wrapper; the serving engine AOT-lowers
+        and compiles it once per bucket shape at startup/first-touch
+        (``.lower(struct).compile()``), so the steady-state dispatch path
+        can never trigger a trace or compile (the GL102 hazard, enforced
+        at runtime by the engine's compile counter).
+        """
+        return jax.jit(
+            fn,
+            in_shardings=(self.batch_sharding,),
+            out_shardings=self.replicated,
+            donate_argnums=DONATE["serve_step"])
 
     # -- checkpoint codec --------------------------------------------------
     def _convert(self, state: Any, templates: Any, n: int) -> Any:

@@ -1,0 +1,24 @@
+"""byol_tpu.serving — the production embedding service.
+
+The user-facing front end over the trained online encoder (the ROADMAP
+"Production embedding service" item): an AOT-compiled, donated, bf16 embed
+step behind a request-coalescing dynamic batcher with pad-to-power-of-two
+bucket shapes, pinned-host staging, and a latency-tail meter wired into the
+schema-versioned event log.  ``python -m byol_tpu serve`` is the CLI;
+``bench.py --serve-ladder`` is the measurement surface.
+"""
+from byol_tpu.serving.batcher import (Backpressure, DynamicBatcher, Request,
+                                      ServiceClosed)
+from byol_tpu.serving.buckets import BucketSpec
+from byol_tpu.serving.engine import ServingEngine
+from byol_tpu.serving.meter import ServingMeter, serve_log_line
+from byol_tpu.serving.service import (EmbeddingService, ServeConfig,
+                                      build_service,
+                                      restore_params_for_serving)
+
+__all__ = [
+    "Backpressure", "BucketSpec", "DynamicBatcher", "EmbeddingService",
+    "Request", "ServeConfig", "ServiceClosed", "ServingEngine",
+    "ServingMeter", "build_service", "restore_params_for_serving",
+    "serve_log_line",
+]

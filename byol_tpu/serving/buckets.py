@@ -1,0 +1,71 @@
+"""Pad-to-power-of-two batch buckets: the serving shape vocabulary.
+
+A dynamic batcher produces a different row count every flush; feeding those
+raw counts to the embed step would compile a fresh executable per distinct
+count — the GL102 recompile hazard, except on the LATENCY hot path where a
+single XLA compile (seconds to minutes) blows every SLO in the queue.  The
+fix is a closed shape vocabulary: every coalesced batch is padded up to the
+smallest power-of-two bucket that holds it, so the engine compiles at most
+``len(spec.sizes)`` programs ever, and steady-state serving reuses them
+forever (pinned by the compile-counter test in tests/test_serving.py).
+
+Power-of-two spacing bounds the padding waste at <2x in the worst case
+(average much lower — the meter's ``fill_ratio`` reports the realized
+waste), while keeping the executable count logarithmic in ``max_batch``.
+``min_bucket`` floors the vocabulary: it must be a multiple of the serving
+mesh's data-axis size (each bucket shards its rows over the chips), and a
+higher floor trades padding waste for fewer programs.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """The bucket vocabulary: powers of two in [min_bucket, max_bucket]."""
+
+    min_bucket: int = 8
+    max_bucket: int = 64
+
+    def __post_init__(self) -> None:
+        if not _is_pow2(self.min_bucket) or not _is_pow2(self.max_bucket):
+            raise ValueError(
+                f"bucket bounds must be powers of two, got "
+                f"[{self.min_bucket}, {self.max_bucket}]")
+        if self.min_bucket > self.max_bucket:
+            raise ValueError(
+                f"min_bucket {self.min_bucket} > max_bucket "
+                f"{self.max_bucket}")
+
+    @property
+    def sizes(self) -> Tuple[int, ...]:
+        """Every bucket, ascending — the engine's full program vocabulary."""
+        out, b = [], self.min_bucket
+        while b <= self.max_bucket:
+            out.append(b)
+            b *= 2
+        return tuple(out)
+
+    def bucket_for(self, rows: int) -> int:
+        """The ONE bucket that serves ``rows``: smallest size >= rows.
+
+        Total (over the vocabulary) and deterministic, so every request
+        count maps to exactly one compiled program — the property test in
+        tests/test_serving.py pins both halves (coverage + uniqueness).
+        """
+        if rows < 1:
+            raise ValueError(f"a batch needs at least one row, got {rows}")
+        if rows > self.max_bucket:
+            raise ValueError(
+                f"{rows} rows exceed the largest bucket "
+                f"{self.max_bucket}; the batcher must flush below it")
+        for b in self.sizes:
+            if rows <= b:
+                return b
+        raise AssertionError("unreachable: rows <= max_bucket")

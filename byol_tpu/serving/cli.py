@@ -1,0 +1,202 @@
+"""``python -m byol_tpu serve`` — stand up the embedding service.
+
+Reuses the TRAINING parser (byol_tpu/cli.py) plus a serving argument
+group, so the net-defining flags (--arch, --half, --normalize-inputs,
+--image-size-override, ...) are spelled exactly as they were at training
+time — the checkpoint only restores into the architecture those flags
+describe.  Serving-only knobs:
+
+    --checkpoint DIR      CheckpointStore root — the trainer saves to
+                          <model_dir>/<run_name> (default .models/...);
+                          empty serves a RANDOM-init encoder (smoke/bench
+                          only — compute is identical, embeddings are
+                          meaningless)
+    --restore-best        restore the best-metric epoch instead of last
+    --min-bucket/--max-batch   the power-of-two bucket vocabulary
+    --max-queue           bounded-queue depth (backpressure past it)
+    --max-wait-ms         coalescing flush deadline
+    --serve-events PATH   serve_stats JSONL log (observability/events.py
+                          schema; default <log_dir>/serve.jsonl)
+    --smoke N             drive N synthetic requests through the full
+                          stack from --smoke-streams client threads,
+                          print the stats line, and exit 0 — the CI wiring
+
+Without --smoke the process serves until SIGINT, emitting a stats window
+every --stats-interval seconds.  (The in-process ``submit()`` API is the
+service's front door; a network listener is a thin adapter away and
+deliberately out of scope here — transport choices should not be welded
+to the batching/compile machinery.)
+"""
+from __future__ import annotations
+
+import sys
+import time
+from typing import List, Optional
+
+
+def build_serve_parser():
+    from byol_tpu.cli import build_parser
+    p = build_parser()
+    p.prog = "python -m byol_tpu serve"
+    s = p.add_argument_group("serving")
+    s.add_argument("--checkpoint", type=str, default="",
+                   help="CheckpointStore directory to restore — the "
+                        "trainer writes <model_dir>/<run_name> (the dir "
+                        "holding ckpt-N/ + meta.json); empty = "
+                        "random-init encoder (smoke/bench only)")
+    s.add_argument("--restore-best", action="store_true",
+                   help="restore the best-metric checkpoint, not the last")
+    s.add_argument("--num-classes", type=int, default=10,
+                   help="probe-head width the checkpoint trained with "
+                        "(tree structure must match to restore)")
+    s.add_argument("--min-bucket", type=int, default=8,
+                   help="smallest pad-to bucket (power of two, multiple "
+                        "of the data-axis size)")
+    s.add_argument("--max-batch", type=int, default=64,
+                   help="largest bucket = the coalescing ceiling "
+                        "(power of two)")
+    s.add_argument("--max-queue", type=int, default=256,
+                   help="bounded request queue depth; submits past it "
+                        "get backpressure")
+    s.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="coalescing flush deadline per batch")
+    s.add_argument("--stats-interval", type=float, default=10.0,
+                   help="seconds between serve_stats event emits")
+    s.add_argument("--serve-events", type=str, default="",
+                   help="serve_stats JSONL path (default "
+                        "<log_dir>/serve.jsonl)")
+    s.add_argument("--smoke", type=int, default=0,
+                   help="drive N synthetic requests through the service, "
+                        "print stats, exit (CI smoke)")
+    s.add_argument("--smoke-streams", type=int, default=4,
+                   help="concurrent client threads for --smoke")
+    s.add_argument("--cpu-devices", type=int, default=0,
+                   help="size a virtual CPU mesh (forces the cpu "
+                        "platform; bench.py's flag, same semantics)")
+    return p
+
+
+def _synthetic_clients(service, n_requests: int, n_streams: int,
+                       input_shape, seed: int = 0) -> int:
+    """Closed-loop synthetic request streams (the smoke/bench driver):
+    each stream submits single-image requests back-to-back until the
+    shared budget is spent.  Returns the number of completed requests."""
+    import threading
+
+    import numpy as np
+
+    budget = {"left": n_requests, "done": 0}
+    lock = threading.Lock()
+
+    def stream(idx: int) -> None:
+        rng = np.random.RandomState(seed + idx)
+        img = rng.rand(*input_shape).astype(np.float32)
+        while True:
+            with lock:
+                if budget["left"] <= 0:
+                    return
+                budget["left"] -= 1
+            service.embed(img, timeout=600.0)
+            with lock:
+                budget["done"] += 1
+
+    threads = [threading.Thread(target=stream, args=(i,), daemon=True)
+               for i in range(max(1, n_streams))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return budget["done"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_serve_parser().parse_args(argv)
+    import os
+
+    from byol_tpu.core import preflight
+    if args.no_cuda:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if args.cpu_devices:
+        preflight.force_cpu_devices(args.cpu_devices)
+    # same killable preflight as train/bench: serving startup must fail
+    # fast against a wedged backend, not hang in native init forever
+    if not preflight.preflight_backend():
+        print("byol_tpu serve: accelerator backend unreachable; pass "
+              "--no-cuda to serve on CPU.", file=sys.stderr)
+        return 2
+
+    from byol_tpu.cli import config_from_args
+    from byol_tpu.observability.events import RunLog
+    from byol_tpu.serving.meter import serve_log_line
+    from byol_tpu.serving.service import ServeConfig, build_service
+
+    cfg = config_from_args(args)
+    serve_cfg = ServeConfig(
+        min_bucket=args.min_bucket, max_bucket=args.max_batch,
+        max_queue=args.max_queue, max_wait_ms=args.max_wait_ms,
+        num_classes=args.num_classes,
+        stats_interval_s=args.stats_interval)
+    events_path = args.serve_events or os.path.join(cfg.task.log_dir,
+                                                    "serve.jsonl")
+    with RunLog(events_path, best_effort=True) as events:
+        import jax
+        events.emit("run_header",
+                    config={**cfg.to_dict(),
+                            "serving": {
+                                "checkpoint": args.checkpoint,
+                                "min_bucket": args.min_bucket,
+                                "max_batch": args.max_batch,
+                                "max_queue": args.max_queue,
+                                "max_wait_ms": args.max_wait_ms}},
+                    jax_version=jax.__version__,
+                    backend=jax.default_backend())
+        service = build_service(cfg, serve_cfg,
+                                checkpoint_dir=args.checkpoint,
+                                best=args.restore_best, events=events)
+        if not args.checkpoint:
+            print("serve: no --checkpoint given — serving a RANDOM-init "
+                  "encoder (embeddings are meaningless; smoke/bench "
+                  "only)", file=sys.stderr)
+        t0 = time.perf_counter()
+        service.start()          # warmup: full bucket vocabulary compiles
+        print(f"serve: warm — {service.engine.compile_count} bucket "
+              f"program(s) {list(service.engine.buckets.sizes)} compiled "
+              f"in {time.perf_counter() - t0:.1f}s; "
+              f"accepting requests ({service.engine.describe()})")
+        try:
+            if args.smoke:
+                done = _synthetic_clients(
+                    service, args.smoke, args.smoke_streams,
+                    service.engine.input_shape, seed=cfg.device.seed)
+                # read the window BEFORE stop(): the final stats emit in
+                # stop() resets it
+                snap = service.meter.snapshot(time.perf_counter(),
+                                              reset=False)
+                service.stop()
+                print(serve_log_line(snap))
+                if done != args.smoke:
+                    print(f"serve: smoke completed {done}/{args.smoke} "
+                          "requests", file=sys.stderr)
+                    return 1
+                events.emit("run_end", smoke_requests=done,
+                            compile_count=service.engine.compile_count)
+                return 0
+            # long-running mode: the worker serves; this thread naps and
+            # flushes stats windows until SIGINT
+            while True:
+                time.sleep(serve_cfg.stats_interval_s)
+                service._emit_stats(force=True)
+        except KeyboardInterrupt:
+            print("serve: SIGINT — draining")
+            return 0
+        finally:
+            if args.smoke == 0:
+                service.stop()
+                events.emit("run_end",
+                            compile_count=service.engine.compile_count)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

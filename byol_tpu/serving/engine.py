@@ -1,0 +1,163 @@
+"""ServingEngine: the AOT-compiled, donated, bf16 embed step per bucket.
+
+The training side can afford jit's trace-on-first-call laziness — a compile
+hides inside startup.  A serving process cannot: a trace or XLA compile on
+the request path is seconds-to-minutes of dead air for every queued user
+(the GL102 recompile hazard, moved to where it hurts most).  So the engine
+is ahead-of-time all the way down:
+
+- the embed step's jit wiring (batch sharded over ``data``, embeddings
+  replicated out, request buffer DONATED) is declared by the compile plan's
+  ``serve`` entry point — parallel/compile_plan.py owns it like every other
+  jitted entry point, and graphlint GL107 polices reintroductions;
+- one executable is ``.lower(shapes).compile()``d per power-of-two bucket
+  (serving/buckets.py), at :meth:`warmup` or on first touch of a bucket;
+  steady state calls ``Compiled`` objects that CANNOT retrace — and
+  :attr:`compile_count` makes that checkable at runtime, so the zero-
+  recompiles-after-warmup contract is a pinned test, not a hope;
+- request rows are assembled into a reusable per-bucket **host staging
+  buffer** and shipped in one transfer; where the backend exposes the
+  ``pinned_host`` memory space (TPU), the transfer hops through a
+  pinned-host placement so the DMA engine reads page-locked memory
+  (probed at construction — CPU backends expose only ``unpinned_host``
+  and take the direct path).
+
+Threading contract: :meth:`embed` is called by ONE thread (the service
+worker) — the staging buffers are reused across calls and must never be
+written concurrently.  Construction/warmup happen before the worker starts.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from byol_tpu.serving.buckets import BucketSpec
+
+
+class ServingEngine:
+    """Per-bucket AOT executables around one frozen representation fn."""
+
+    def __init__(self, represent_fn: Callable, plan: Any,
+                 input_shape: Tuple[int, int, int],
+                 buckets: BucketSpec,
+                 input_dtype: np.dtype = np.float32) -> None:
+        n = plan.num_shards
+        if buckets.min_bucket % n != 0:
+            raise ValueError(
+                f"min_bucket {buckets.min_bucket} must be a multiple of "
+                f"the serving mesh's data-axis size {n}: every bucket "
+                "shards its rows over the chips")
+        self._plan = plan
+        self._mesh = plan.mesh
+        self._jitted = plan.jit_serve_step(represent_fn)
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self.buckets = buckets
+        self._executables: Dict[int, Any] = {}
+        self._staging: Dict[int, np.ndarray] = {}
+        self.compile_count = 0
+        self.compile_seconds: Dict[int, float] = {}
+        self._pinned = self._probe_pinned_host()
+
+    # ---- staging ----------------------------------------------------------
+    def _probe_pinned_host(self):
+        """The pinned-host placement for staged request batches, or None.
+
+        Probed with a real tiny transfer, not a capability flag: the
+        memory-kind API exists on every backend but only TPU-class ones
+        address a ``pinned_host`` space (CPU raises at placement time).
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from byol_tpu.parallel.mesh import DATA_AXIS
+        try:
+            sh = NamedSharding(self._mesh, P(DATA_AXIS),
+                               memory_kind="pinned_host")
+            n = self._plan.num_shards
+            probe = jax.device_put(
+                np.zeros((n, 1), np.float32), sh)
+            probe.block_until_ready()
+            return sh
+        except (ValueError, RuntimeError, TypeError):
+            return None
+
+    def _stage(self, rows: np.ndarray, bucket: int):
+        """rows -> device-resident padded batch in the plan's layout.
+
+        One reusable host buffer per bucket (no per-request allocation),
+        zeroed pad tail (stale rows from the previous batch must never
+        alias into this one), one transfer — through pinned-host pages
+        when the backend has them.
+        """
+        buf = self._staging.get(bucket)
+        if buf is None:
+            buf = np.zeros((bucket,) + self.input_shape, self.input_dtype)
+            self._staging[bucket] = buf
+        n = rows.shape[0]
+        buf[:n] = rows
+        if n < bucket:
+            buf[n:] = 0
+        if self._pinned is not None:
+            host = jax.device_put(buf, self._pinned)
+            return jax.device_put(host, self._plan.batch_sharding)
+        return jax.device_put(buf, self._plan.batch_sharding)
+
+    # ---- compilation ------------------------------------------------------
+    def _compile(self, bucket: int) -> Any:
+        struct = jax.ShapeDtypeStruct((bucket,) + self.input_shape,
+                                      self.input_dtype)
+        t0 = time.perf_counter()
+        with self._mesh:
+            exe = self._jitted.lower(struct).compile()
+        self.compile_seconds[bucket] = time.perf_counter() - t0
+        self._executables[bucket] = exe
+        self.compile_count += 1
+        return exe
+
+    def warmup(self) -> None:
+        """Compile the full bucket vocabulary up front, so the first real
+        request of ANY size hits a ready executable.  After this, a
+        growing :attr:`compile_count` is a bug by contract."""
+        for b in self.buckets.sizes:
+            if b not in self._executables:
+                self._compile(b)
+
+    # ---- the hot path -----------------------------------------------------
+    def embed(self, rows: np.ndarray) -> np.ndarray:
+        """``(n, H, W, C)`` request rows -> ``(n, D)`` fp32 embeddings.
+
+        Pads to the row count's bucket, runs that bucket's executable
+        (compiling it first only if warmup never touched it), and slices
+        the pad rows back off.  The readback blocks — the worker's batch
+        cadence IS the serving cadence, there is nothing to run ahead to.
+        """
+        n = rows.shape[0]
+        bucket = self.buckets.bucket_for(n)
+        exe = self._executables.get(bucket)
+        if exe is None:
+            exe = self._compile(bucket)
+        staged = self._stage(rows, bucket)
+        out = exe(staged)
+        # EXPLICIT readback (device_get, not np.asarray): the embed path
+        # runs clean under jax.transfer_guard("disallow") — any IMPLICIT
+        # transfer in here is a bug the guard_steps test would catch.
+        host = jax.device_get(out)
+        # copy when padded: a [:n] VIEW would pin the full (bucket, D)
+        # buffer for as long as any caller holds the result
+        return host[:n] if n == bucket else host[:n].copy()
+
+    def describe(self) -> Dict[str, Any]:
+        """Provenance for the serve run header / bench rows."""
+        return {
+            "buckets": list(self.buckets.sizes),
+            "input_shape": list(self.input_shape),
+            "input_dtype": str(self.input_dtype),
+            "compile_count": self.compile_count,
+            "compile_seconds": {str(k): round(v, 3)
+                                for k, v in self.compile_seconds.items()},
+            "pinned_host_staging": self._pinned is not None,
+            "mesh_shape": {str(k): int(v)
+                           for k, v in self._mesh.shape.items()},
+        }

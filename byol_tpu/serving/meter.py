@@ -1,0 +1,137 @@
+"""ServingMeter: the latency-path health surface.
+
+The training side reports img/s/chip and HBM high water; the serving side's
+SLO currency is the LATENCY TAIL — p50 says what a typical user feels, p99
+says what the unlucky ones feel, and the gap between them is where queueing
+and batching policy live.  This meter collects, per emit window:
+
+- request/row/batch counts and achieved rows/sec;
+- p50/p99 request latency (enqueue -> result ready, the full user-visible
+  path: queue wait + coalesce wait + staging + embed + readback);
+- batch **fill ratio** (rows / bucket rows): the padding waste the
+  power-of-two vocabulary costs — low fill at high load means the bucket
+  floor is too high, high fill with high p99 means ``max_wait`` is doing
+  the batching, not traffic;
+- queue depth at enqueue (backpressure proximity).
+
+Snapshots emit through observability/events.py as schema-versioned
+``serve_stats`` lines — the same JSONL stream tooling already reads for
+runs and benches, so one reader graphs training health and serving SLOs
+alike.  Thread-safety: producers (client threads) and the consumer (the
+service worker) record under one lock; recording is a few float ops, far
+off the embed path's critical section.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+# latency ring capacity: enough for a stats window at serving rates without
+# unbounded growth on a long-lived process (percentiles are per-window —
+# the window resets on every emit/snapshot(reset=True))
+_RING = 65536
+
+
+def _ms(seconds: float) -> float:
+    return seconds * 1e3
+
+
+class ServingMeter:
+    """Windowed serving stats; ``snapshot()`` reads, ``emit()`` logs."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._latencies = collections.deque(maxlen=_RING)
+        self._requests = 0
+        self._rows = 0
+        self._batches = 0
+        self._bucket_rows = 0       # sum of padded bucket sizes dispatched
+        self._depth_sum = 0         # queue depth sampled at each enqueue
+        self._depth_samples = 0
+        self._window_start = None   # first record in the current window
+        # lifetime totals (never reset): the run_end summary
+        self.total_requests = 0
+        self.total_batches = 0
+
+    # ---- producer side (client threads) -----------------------------------
+    def record_enqueue(self, queue_depth: int) -> None:
+        with self._lock:
+            self._depth_sum += int(queue_depth)
+            self._depth_samples += 1
+
+    # ---- consumer side (the service worker) -------------------------------
+    def record_batch(self, rows: int, bucket: int, t_now: float) -> None:
+        with self._lock:
+            if self._window_start is None:
+                self._window_start = t_now
+            self._batches += 1
+            self._rows += int(rows)
+            self._bucket_rows += int(bucket)
+            self.total_batches += 1
+
+    def record_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(float(seconds))
+            self._requests += 1
+            self.total_requests += 1
+
+    # ---- readout ----------------------------------------------------------
+    def snapshot(self, t_now: float, *, reset: bool = True
+                 ) -> Dict[str, float]:
+        """The current window's stats dict (the ``serve_stats`` payload).
+
+        Empty windows report NaN percentiles — events.py maps them to the
+        string ``"NaN"`` at emit time, so an idle window stays a valid,
+        parseable line rather than a crash or a fake zero latency.
+        """
+        with self._lock:
+            lat = np.asarray(self._latencies, np.float64)
+            elapsed = (t_now - self._window_start
+                       if self._window_start is not None else 0.0)
+            out = {
+                "requests": float(self._requests),
+                "rows": float(self._rows),
+                "batches": float(self._batches),
+                "p50_ms": (_ms(float(np.percentile(lat, 50)))
+                           if lat.size else float("nan")),
+                "p99_ms": (_ms(float(np.percentile(lat, 99)))
+                           if lat.size else float("nan")),
+                "mean_ms": (_ms(float(lat.mean()))
+                            if lat.size else float("nan")),
+                "fill_ratio": (self._rows / self._bucket_rows
+                               if self._bucket_rows else float("nan")),
+                "queue_depth": (self._depth_sum / self._depth_samples
+                                if self._depth_samples else 0.0),
+                "rows_per_sec": (self._rows / elapsed
+                                 if elapsed > 0 else float("nan")),
+            }
+            if reset:
+                self._latencies.clear()
+                self._requests = self._rows = self._batches = 0
+                self._bucket_rows = 0
+                self._depth_sum = self._depth_samples = 0
+                self._window_start = None
+            return out
+
+    def emit(self, events: Optional[Any], t_now: float, *,
+             reset: bool = True, **extra: Any) -> Dict[str, float]:
+        """Emit one ``serve_stats`` event (when ``events`` is a RunLog) and
+        return the snapshot; ``extra`` carries engine-side fields the meter
+        cannot know (compile_count, bucket vocabulary)."""
+        snap = self.snapshot(t_now, reset=reset)
+        if events is not None:
+            events.emit("serve_stats", **snap, **extra)
+        return snap
+
+
+def serve_log_line(snap: Dict[str, float]) -> str:
+    """One-line human summary of a stats window (the epoch-line analog)."""
+    return (f"serve[{int(snap['requests'])} req / "
+            f"{int(snap['batches'])} batches]: "
+            f"p50 {snap['p50_ms']:.2f} ms\tp99 {snap['p99_ms']:.2f} ms\t"
+            f"fill {snap['fill_ratio']:.2f}\t"
+            f"queue {snap['queue_depth']:.2f}\t"
+            f"{snap['rows_per_sec']:.1f} rows/s")

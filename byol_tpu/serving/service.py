@@ -1,0 +1,318 @@
+"""EmbeddingService: the user-facing front end over the trained encoder.
+
+Wires the three serving parts into one object with a two-method API —
+``submit(images) -> future`` and ``stop()``:
+
+    client threads -> DynamicBatcher (bounded queue, coalesce, max-wait)
+                   -> worker thread -> ServingEngine (bucket-padded AOT
+                      embed, pinned-host staging) -> per-request futures
+
+plus a :class:`~byol_tpu.serving.meter.ServingMeter` that samples queue
+depth / fill ratio / latency tail and emits ``serve_stats`` events through
+the schema-versioned run log (observability/events.py) — the serving
+counterpart of trainer.fit's run.jsonl.
+
+:func:`build_service` is the startup path the CLI and bench use: rebuild
+the encoder from a Config, restore a training checkpoint through the
+compile plan's CANONICAL codec (checkpoints are mesh-size portable — a
+state trained 8-way ZeRO-1 restores onto a 4-chip or 1-chip serving mesh,
+tests/test_serving.py pins it), and AOT-compile the bucket vocabulary
+before the first request can arrive.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from byol_tpu.serving.batcher import DynamicBatcher, Request
+from byol_tpu.serving.buckets import BucketSpec
+from byol_tpu.serving.engine import ServingEngine
+from byol_tpu.serving.meter import ServingMeter
+
+
+class EmbeddingService:
+    """Batcher + engine + meter under one worker thread."""
+
+    def __init__(self, engine: ServingEngine, batcher: DynamicBatcher,
+                 *, meter: Optional[ServingMeter] = None,
+                 events: Optional[Any] = None,
+                 stats_interval_s: float = 10.0) -> None:
+        self.engine = engine
+        self.batcher = batcher
+        self.meter = meter if meter is not None else ServingMeter()
+        self.events = events
+        self.stats_interval_s = stats_interval_s
+        self._thread: Optional[threading.Thread] = None
+        self._last_stats = time.perf_counter()
+        # serializes stats emits: the worker (per batch) and the CLI's
+        # interval loop both call _emit_stats, and RunLog's line-buffered
+        # TextIOWrapper is not thread-safe — two concurrent emits could
+        # interleave bytes and corrupt a JSONL line
+        self._stats_lock = threading.Lock()
+
+    # ---- lifecycle --------------------------------------------------------
+    def start(self, *, warmup: bool = True) -> "EmbeddingService":
+        """AOT-compile the bucket vocabulary (unless ``warmup=False``) and
+        start the worker.  Warmup belongs HERE, before the queue opens for
+        traffic — a compile after start() would stall live requests."""
+        if self._thread is not None:
+            raise RuntimeError("service already started")
+        if warmup:
+            self.engine.warmup()
+        self._thread = threading.Thread(target=self._run,
+                                        name="embedding_service",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Close the queue, drain what was accepted, join the worker, and
+        emit a final stats window — every request's future RESOLVES: with
+        embeddings if the worker drained it, with ServiceClosed if its
+        submit raced close() into the already-drained queue (nobody may
+        block forever on a future the worker will never see)."""
+        from byol_tpu.serving.batcher import ServiceClosed
+        self.batcher.close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self.batcher.fail_pending(
+            ServiceClosed("the service stopped before this request was "
+                          "dispatched"))
+        self._emit_stats(force=True)
+
+    def __enter__(self) -> "EmbeddingService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- client API -------------------------------------------------------
+    def submit(self, images: np.ndarray,
+               timeout: Optional[float] = 1.0) -> Request:
+        """Enqueue ``(rows, H, W, C)`` images; returns the future.  Blocks
+        up to ``timeout`` when the bounded queue is full, then raises
+        :class:`~byol_tpu.serving.batcher.Backpressure`.
+
+        The per-row shape is validated against the engine's input contract
+        HERE, in the client's thread: a wrong-sized image must be that
+        client's ValueError, never a mid-coalesce concatenate failure that
+        takes down an innocent batch."""
+        images = np.asarray(images)
+        row_shape = images.shape[1:] if images.ndim == 4 else images.shape
+        if tuple(row_shape) != self.engine.input_shape:
+            raise ValueError(
+                f"request rows of shape {tuple(row_shape)} do not match "
+                f"the served model's input {self.engine.input_shape}")
+        req = self.batcher.submit(images, timeout=timeout)
+        self.meter.record_enqueue(self.batcher.depth())
+        return req
+
+    def embed(self, images: np.ndarray,
+              timeout: Optional[float] = None) -> np.ndarray:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(images).result(timeout)
+
+    # ---- worker -----------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            batch = self.batcher.next_batch()
+            if batch is None:
+                return
+            try:
+                # assembly INSIDE the relay: any per-batch failure —
+                # including one the submit-time validation did not
+                # foresee — belongs to this batch's futures, never to
+                # the worker thread (whose death would strand the queue)
+                rows = (batch[0].images if len(batch) == 1 else
+                        np.concatenate([r.images for r in batch], axis=0))
+                embeddings = self.engine.embed(rows)
+            except Exception as e:  # noqa: BLE001 — relayed per request
+                for r in batch:
+                    r.set_error(e)
+                continue
+            t_now = time.perf_counter()
+            self.meter.record_batch(
+                rows.shape[0], self.engine.buckets.bucket_for(rows.shape[0]),
+                t_now)
+            lo = 0
+            for r in batch:
+                # latency recorded BEFORE set_result: a client returning
+                # from result() (e.g. the bench rung joining its streams
+                # and snapshotting the meter) must find its own sample
+                # already counted — recording after would race the reader
+                self.meter.record_latency(r.latency(t_now))
+                # per-request COPY, not a view: a client holding one
+                # request's rows must not pin the whole batch's buffer
+                # for its lifetime
+                sl = embeddings[lo:lo + r.rows]
+                r.set_result(sl if len(batch) == 1 else sl.copy())
+                lo += r.rows
+            self._emit_stats()
+
+    def _emit_stats(self, force: bool = False) -> None:
+        with self._stats_lock:
+            t_now = time.perf_counter()
+            if (not force
+                    and t_now - self._last_stats < self.stats_interval_s):
+                return
+            self._last_stats = t_now
+            self.meter.emit(self.events, t_now,
+                            compile_count=self.engine.compile_count)
+
+
+# --------------------------------------------------------------------------
+# startup: config + checkpoint -> a warmed service
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving-only knobs (the training knobs ride in the main Config)."""
+
+    min_bucket: int = 8
+    max_bucket: int = 64
+    max_queue: int = 256
+    max_wait_ms: float = 5.0
+    num_classes: int = 10        # probe-head width the checkpoint trained
+    stats_interval_s: float = 10.0
+
+
+def _abstract_canonical_state(rcfg, net, plan):
+    """Shape/dtype skeleton of the CANONICAL TrainState for checkpoint
+    restore, with every leaf placed replicated on the serving mesh.
+
+    Built under ``jax.eval_shape`` — no parameter, momentum, or EMA buffer
+    is materialized just to learn the tree structure.  Canonical is the
+    layout every checkpoint stores regardless of the training plan
+    (compile_plan.to_canonical), which is exactly what makes a ckpt from
+    an 8-way ZeRO-1 run restorable onto ANY serving mesh size.
+    """
+    import jax
+
+    from byol_tpu.training.build import build_tx, init_variables
+    from byol_tpu.training.state import create_train_state
+
+    cfg = rcfg.cfg
+
+    def make():
+        variables = init_variables(net, rcfg, jax.random.PRNGKey(0))
+        tx, _ = build_tx(rcfg)
+        return create_train_state(
+            variables, tx, ema_init_mode=cfg.parity.ema_init_mode,
+            polyak_ema=cfg.regularizer.polyak_ema)
+
+    abstract = jax.eval_shape(make)
+    rep = plan.replicated
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(tuple(s.shape), s.dtype,
+                                       sharding=rep), abstract)
+
+
+def restore_params_for_serving(cfg, checkpoint_dir: str, mesh, *,
+                               num_classes: int = 10,
+                               best: bool = False,
+                               epoch: Optional[int] = None
+                               ) -> Tuple[Any, Any, Any, int]:
+    """Restore ``(net, params, batch_stats, epoch)`` from a training
+    checkpoint onto the serving mesh.
+
+    The full canonical state is restored (orbax needs the stored tree's
+    structure), then everything but the forward-pass leaves is dropped —
+    a serving process never pays steady-state HBM for LARS momentum.
+    """
+    from byol_tpu.checkpoint import CheckpointStore
+    from byol_tpu.parallel.compile_plan import build_plan
+    from byol_tpu.training.build import build_net
+
+    rcfg = _serving_rcfg(cfg, num_classes)
+    net = build_net(rcfg)
+    plan = build_plan(mesh)   # serving is always the replicated plan
+    store = CheckpointStore(checkpoint_dir)
+    try:
+        state, at_epoch = store.restore(
+            _abstract_canonical_state(rcfg, net, plan), epoch=epoch,
+            best=best)
+    finally:
+        store.close()
+    params, batch_stats = state.params, state.batch_stats
+    del state                 # free momentum/EMA/polyak buffers now
+    return net, params, batch_stats, at_epoch
+
+
+def _serving_rcfg(cfg, num_classes: int):
+    """Resolve a Config without a loader: serving knows its input contract
+    from the config alone (image size, channels, probe width).  The sample
+    counts only have to satisfy resolve()'s divisibility checks — nothing
+    downstream of the net/optimizer structure reads them here."""
+    from byol_tpu.core.config import resolve
+    size = cfg.task.image_size_override or 224
+    return resolve(cfg,
+                   num_train_samples=cfg.task.batch_size,
+                   num_test_samples=cfg.task.batch_size,
+                   output_size=num_classes,
+                   input_shape=(size, size, 3))
+
+
+def build_service(cfg, serve_cfg: ServeConfig, *,
+                  checkpoint_dir: str = "", mesh=None, best: bool = False,
+                  epoch: Optional[int] = None,
+                  events: Optional[Any] = None) -> EmbeddingService:
+    """Config (+ optional checkpoint) -> a constructed (NOT started)
+    EmbeddingService on ``mesh`` (default: all visible devices on the
+    data axis).
+
+    ``checkpoint_dir=""`` serves a RANDOM-init encoder — meaningless
+    embeddings, identical compute: the smoke/bench path (latency does not
+    depend on parameter values, and CI has no trained checkpoint).
+    """
+    import jax
+
+    from byol_tpu.parallel.compile_plan import build_plan
+    from byol_tpu.parallel.mesh import MeshSpec, build_mesh
+    from byol_tpu.training.build import build_net, init_variables
+    from byol_tpu.training.linear_eval import frozen_representation_fn
+
+    if mesh is None:
+        mesh = build_mesh(MeshSpec(data=len(jax.devices())))
+    # bucket/mesh compatibility validated BEFORE the model build or
+    # checkpoint restore: a bad --min-bucket/--max-batch/device-count
+    # must cost an actionable error now, not a traceback after minutes
+    # of encoder construction (BucketSpec checks the power-of-two and
+    # ordering constraints; the divisibility check mirrors the engine's)
+    from byol_tpu.parallel.mesh import DATA_AXIS
+    buckets = BucketSpec(min_bucket=serve_cfg.min_bucket,
+                         max_bucket=serve_cfg.max_bucket)
+    n_shards = int(mesh.shape[DATA_AXIS])
+    if buckets.min_bucket % n_shards != 0:
+        raise ValueError(
+            f"min_bucket {buckets.min_bucket} must be a multiple of the "
+            f"serving mesh's data-axis size {n_shards}: every bucket "
+            "shards its rows over the chips (use a power-of-two device "
+            "count and min_bucket >= it)")
+    rcfg = _serving_rcfg(cfg, serve_cfg.num_classes)
+    if checkpoint_dir:
+        net, params, batch_stats, _ = restore_params_for_serving(
+            cfg, checkpoint_dir, mesh, num_classes=serve_cfg.num_classes,
+            best=best, epoch=epoch)
+    else:
+        net = build_net(rcfg)
+        with mesh:
+            variables = init_variables(net, rcfg, jax.random.PRNGKey(
+                cfg.device.seed))
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+    represent = frozen_representation_fn(
+        net, params, batch_stats, half=cfg.device.half,
+        normalize=cfg.parity.normalize_inputs)
+    plan = build_plan(mesh)
+    engine = ServingEngine(represent, plan, input_shape=rcfg.input_shape,
+                           buckets=buckets)
+    batcher = DynamicBatcher(max_batch=serve_cfg.max_bucket,
+                             max_queue=serve_cfg.max_queue,
+                             max_wait_s=serve_cfg.max_wait_ms / 1e3)
+    return EmbeddingService(engine, batcher, events=events,
+                            stats_interval_s=serve_cfg.stats_interval_s)

@@ -53,6 +53,29 @@ def _prep_inputs(x, policy, normalize: bool):
     return normalize_images(xc) if normalize else xc
 
 
+def frozen_representation_fn(net, params, batch_stats, *, half: bool = False,
+                             normalize: bool = False) -> Callable:
+    """The ONE traceable frozen-encoder core: ``images -> fp32
+    representations`` (bf16 compute as trained, fp32 out).
+
+    Every consumer of frozen BYOL features — both linear-eval extractors
+    below and the serving embed step (byol_tpu/serving/engine.py) — wraps
+    THIS function, so the input contract (compute dtype, Quirk Q3
+    normalization) and the representation read-out cannot drift between
+    the offline-eval and serving surfaces: a served embedding is
+    definitionally what the linear-eval protocol would have scored."""
+    from byol_tpu.core.precision import get_policy
+    policy = get_policy(half)
+
+    def represent(x):
+        out = net.apply({"params": params, "batch_stats": batch_stats},
+                        _prep_inputs(x, policy, normalize), train=False,
+                        mutable=False)
+        return out["representation"].astype(jnp.float32)
+
+    return represent
+
+
 @dataclasses.dataclass
 class LinearEvalResult:
     top1: float
@@ -102,19 +125,17 @@ def encoder_extractor_spmd(net, state, mesh, *, half: bool = False,
     out_shardings (declared by the compile plan, which owns every jit
     entry point's shardings) is the cross-host all-gather, so every host
     can read the full result with a plain ``np.asarray``."""
-    from byol_tpu.core.precision import get_policy
     from byol_tpu.parallel.compile_plan import build_plan
-    policy = get_policy(half)
     # Extraction reads only params/batch_stats, which stay replicated under
     # every plan (ZeRO-1 shards momentum/EMA only) — the default plan's
     # extractor wiring serves states trained under any layout.
     plan = build_plan(mesh)
+    represent = frozen_representation_fn(net, state.params,
+                                         state.batch_stats, half=half,
+                                         normalize=normalize)
 
     def apply(x, y, mask):
-        out = net.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            _prep_inputs(x, policy, normalize), train=False, mutable=False)
-        return out["representation"].astype(jnp.float32), y, mask
+        return represent(x), y, mask
 
     return plan.jit_spmd_extractor(apply)
 
@@ -311,17 +332,10 @@ def encoder_apply_fn(net, state, *, half: bool = False,
     """Jitted frozen-encoder feature extractor from a TrainState (the
     single-host entry point; its default-placement jit wiring is declared
     in the compile plan alongside the sharded entry points)."""
-    from byol_tpu.core.precision import get_policy
     from byol_tpu.parallel.compile_plan import jit_encoder_extractor
-    policy = get_policy(half)
-
-    def apply(x):
-        out = net.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            _prep_inputs(x, policy, normalize), train=False, mutable=False)
-        return out["representation"].astype(jnp.float32)
-
-    return jit_encoder_extractor(apply)
+    return jit_encoder_extractor(frozen_representation_fn(
+        net, state.params, state.batch_stats, half=half,
+        normalize=normalize))
 
 
 def run_linear_eval_from_cfg(cfg, state, *, loader=None, mesh=None,

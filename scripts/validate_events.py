@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Validate JSONL event logs against the observability/events.py schema.
+
+CI wiring (ISSUE 8 satellite): every event stream the repo emits —
+trainer.fit's run.jsonl, bench.py's bench_events.jsonl, the serving CLI's
+serve.jsonl — claims the same schema; this script round-trips each given
+file through the STRICT reader (``read_events``: per-line JSON parse +
+per-kind required-field validation) so a writer drifting from the schema
+fails the build instead of silently producing logs no tool can parse.
+
+Usage: ``python scripts/validate_events.py FILE [FILE ...]``
+Exits non-zero on the first invalid file, naming the line.  A missing
+file is an error (CI passes exactly the files the preceding steps
+produced); an empty file is an error too — a step that claims to emit
+events and emits none is itself drift.
+
+Pure-stdlib + numpy import chain (events.py), no jax: safe to run before
+or after any backend-touching step.
+"""
+from __future__ import annotations
+
+import collections
+import importlib.util
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_events_module():
+    """Load observability/events.py by PATH, bypassing the byol_tpu
+    package __init__ (which drags in telemetry and therefore jax) — the
+    schema module itself needs only stdlib + numpy, and this script must
+    stay runnable in environments with no accelerator stack."""
+    path = os.path.join(_ROOT, "byol_tpu", "observability", "events.py")
+    spec = importlib.util.spec_from_file_location("_events_schema", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def validate(path: str) -> str:
+    events_mod = _load_events_module()
+    kinds = collections.Counter()
+    for event in events_mod.read_events(path):
+        kinds[event["kind"]] += 1
+    if not kinds:
+        raise ValueError(f"{path}: no events — the emitting step wrote an "
+                         "empty log")
+    return ", ".join(f"{k}={n}" for k, n in sorted(kinds.items()))
+
+
+def main(argv) -> int:
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    for path in argv:
+        try:
+            summary = validate(path)
+        except (OSError, ValueError) as e:
+            print(f"validate_events: FAIL {e}", file=sys.stderr)
+            return 1
+        print(f"validate_events: ok {path} ({summary})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

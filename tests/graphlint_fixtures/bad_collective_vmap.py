@@ -1,0 +1,37 @@
+"""GL108 must-fire corpus: collectives over axis names nothing binds.
+
+Three bugs:
+1. a ``lax.pmean`` over ``'batch'`` inside a function vmapped with
+   ``axis_name='i'`` — the classic rename drift: the vmap's axis was
+   renamed, the collective inside was not, and the NameError fires at the
+   vmap call site instead of here;
+2. the same drift spelled through a module constant;
+3. an ``all_gather`` over an axis neither any vmap nor the declared mesh
+   vocabulary (AXIS_NAMES below) contains.
+"""
+import jax
+from jax import lax
+
+DATA_AXIS = "data"
+AXIS_NAMES = (DATA_AXIS,)
+
+STALE_AXIS = "microbatch"     # the pre-rename spelling nothing binds now
+
+
+def microbatch_mean(xs):
+    def body(x):
+        # BUG: the surrounding vmap binds 'i', not 'batch'
+        return lax.pmean(x * x, "batch")
+    return jax.vmap(body, axis_name="i")(xs)
+
+
+def microbatch_sum(xs):
+    def body(x):
+        # BUG: STALE_AXIS resolves to 'microbatch', which nothing binds
+        return lax.psum(x, STALE_AXIS)
+    return jax.vmap(body, axis_name="i")(xs)
+
+
+def gather_everything(x):
+    # BUG: 'shards' is neither a vmap axis nor a declared mesh axis
+    return lax.all_gather(x, "shards")

@@ -1,0 +1,43 @@
+"""GL108 near-miss corpus: everything here must stay clean.
+
+Collectives over axes that ARE bound (by a vmap in this module, or by the
+declared mesh vocabulary that shard_map/GSPMD binds at runtime), spelled
+directly, via module constants, and in tuple form; plus the wrapper
+pattern — an axis name arriving as a function parameter is unresolvable
+and the rule must stand down, not guess.
+"""
+import jax
+from jax import lax
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+AXIS_NAMES = (DATA_AXIS, MODEL_AXIS)
+
+ACCUM_AXIS = "accum"
+
+
+def microbatch_mean(xs):
+    def body(x):
+        # bound by the surrounding vmap below — fine
+        return lax.pmean(x * x, ACCUM_AXIS)
+    return jax.vmap(body, axis_name=ACCUM_AXIS)(xs)
+
+
+def mesh_reduce(x):
+    # 'data' is a declared mesh axis (AXIS_NAMES): shard_map binds it
+    return lax.psum(x, DATA_AXIS)
+
+
+def mesh_reduce_tuple(x):
+    # tuple form over declared axes only
+    return lax.psum(x, (DATA_AXIS, "model"))
+
+
+def wrapped_psum(x, axis_name=DATA_AXIS):
+    # parameter axis: unresolvable — the rule must not guess
+    return lax.psum(x, axis_name)
+
+
+def my_rank():
+    # axis_index over a declared mesh axis
+    return lax.axis_index("data")

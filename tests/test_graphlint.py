@@ -39,6 +39,7 @@ RULE_CASES = [
     ("GL105", "bad_remat_tags.py", "ok_remat_tags.py"),
     ("GL106", "bad_cli_drift.py", "ok_cli_drift.py"),
     ("GL107", "bad_sharding_axes.py", "ok_sharding_axes.py"),
+    ("GL108", "bad_collective_vmap.py", "ok_collective_vmap.py"),
 ]
 
 

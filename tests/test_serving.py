@@ -1,0 +1,527 @@
+"""byol_tpu/serving/ — the embedding service (ISSUE 8 tentpole).
+
+Four layers, cheapest first:
+
+1. **Buckets**: the pad-to-power-of-two vocabulary is total and unique —
+   every request row count maps to exactly ONE bucket (the property that
+   makes the compile count an invariant rather than a load artifact).
+2. **Batcher**: pure host-side policy — coalescing, the max-wait flush
+   deadline, overflow carry, bounded-queue backpressure, drain-on-close.
+3. **Engine/service correctness**: served embeddings BITWISE-match the
+   linear-eval extractor for the same checkpoint and inputs (the serving
+   path may add batching, padding, sharding, and AOT compilation, but it
+   must never add numerics), under the guard_steps transfer guard; the
+   checkpoint restores onto FEWER devices than it trained on.
+4. **Compile discipline**: compile count == number of distinct buckets
+   touched, and warmed steady-state serving issues ZERO recompiles (the
+   GL102 hazard pinned at runtime).
+"""
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import jax
+
+from byol_tpu.serving.batcher import (Backpressure, DynamicBatcher,
+                                      ServiceClosed)
+from byol_tpu.serving.buckets import BucketSpec
+from byol_tpu.serving.meter import ServingMeter, serve_log_line
+from byol_tpu.serving.service import EmbeddingService
+from tests.conftest import guard_steps
+
+
+# ---------------------------------------------------------------------------
+# 1. buckets
+# ---------------------------------------------------------------------------
+
+class TestBuckets:
+    def test_every_row_count_maps_to_exactly_one_bucket(self):
+        spec = BucketSpec(min_bucket=8, max_bucket=64)
+        assert spec.sizes == (8, 16, 32, 64)
+        for n in range(1, 65):
+            b = spec.bucket_for(n)
+            # coverage: the bucket holds the rows
+            assert b in spec.sizes and b >= n
+            # uniqueness/minimality: no SMALLER bucket could hold them,
+            # so no other bucket can be "the" bucket for n
+            smaller = [s for s in spec.sizes if s < b]
+            assert all(s < n for s in smaller)
+            # determinism
+            assert spec.bucket_for(n) == b
+
+    def test_single_bucket_spec(self):
+        spec = BucketSpec(min_bucket=16, max_bucket=16)
+        assert spec.sizes == (16,)
+        assert spec.bucket_for(1) == 16 and spec.bucket_for(16) == 16
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            BucketSpec(min_bucket=6, max_bucket=64)      # not a pow2
+        with pytest.raises(ValueError):
+            BucketSpec(min_bucket=32, max_bucket=8)      # inverted
+        spec = BucketSpec(min_bucket=8, max_bucket=32)
+        with pytest.raises(ValueError):
+            spec.bucket_for(33)                          # over the ceiling
+        with pytest.raises(ValueError):
+            spec.bucket_for(0)
+
+
+# ---------------------------------------------------------------------------
+# 2. batcher (no jax anywhere)
+# ---------------------------------------------------------------------------
+
+def _img(rows=1, size=4):
+    return np.zeros((rows, size, size, 3), np.float32)
+
+
+class TestBatcher:
+    def test_coalesces_up_to_max_batch(self):
+        b = DynamicBatcher(max_batch=8, max_wait_s=0.2)
+        for _ in range(4):
+            b.submit(_img(2), timeout=0.1)
+        batch = b.next_batch()
+        assert len(batch) == 4
+        assert sum(r.rows for r in batch) == 8
+
+    def test_overflow_request_is_carried_never_split(self):
+        b = DynamicBatcher(max_batch=8, max_wait_s=0.2)
+        b.submit(_img(6), timeout=0.1)
+        b.submit(_img(5), timeout=0.1)     # 6+5 > 8: must not join
+        first = b.next_batch()
+        assert [r.rows for r in first] == [6]
+        second = b.next_batch()
+        assert [r.rows for r in second] == [5]
+
+    def test_max_wait_deadline_flushes_partial_batch(self):
+        b = DynamicBatcher(max_batch=64, max_wait_s=0.05)
+        b.submit(_img(2), timeout=0.1)
+        t0 = time.perf_counter()
+        batch = b.next_batch()
+        waited = time.perf_counter() - t0
+        assert sum(r.rows for r in batch) == 2       # flushed well short
+        assert waited < 5.0                          # of max_batch
+        # and the deadline actually gated the flush (>= max_wait, minus
+        # scheduler slop)
+        assert waited >= 0.04
+
+    def test_backpressure_when_queue_full(self):
+        b = DynamicBatcher(max_batch=4, max_queue=2, max_wait_s=0.01)
+        b.submit(_img(), timeout=0.1)
+        b.submit(_img(), timeout=0.1)
+        with pytest.raises(Backpressure):
+            b.submit(_img(), timeout=0.05)
+        # draining one frees a slot
+        assert b.next_batch() is not None
+        b.submit(_img(), timeout=0.5)
+
+    def test_oversized_and_empty_requests_rejected(self):
+        b = DynamicBatcher(max_batch=4)
+        with pytest.raises(ValueError):
+            b.submit(_img(5), timeout=0.1)
+        with pytest.raises(ValueError):
+            b.submit(_img(0), timeout=0.1)
+        with pytest.raises(ValueError):
+            b.submit(np.zeros((4, 4), np.float32), timeout=0.1)
+
+    def test_single_image_lifted_to_one_row(self):
+        b = DynamicBatcher(max_batch=4, max_wait_s=0.01)
+        req = b.submit(np.zeros((4, 4, 3), np.float32), timeout=0.1)
+        assert req.rows == 1
+        assert b.next_batch()[0] is req
+
+    def test_close_drains_then_ends(self):
+        b = DynamicBatcher(max_batch=2, max_wait_s=0.01)
+        b.submit(_img(), timeout=0.1)
+        b.close()
+        with pytest.raises(ServiceClosed):
+            b.submit(_img(), timeout=0.1)
+        assert b.next_batch() is not None    # queued work still served
+        assert b.next_batch(poll_s=0.01) is None
+
+    def test_fail_pending_resolves_raced_requests(self):
+        """A submit that raced close() into an already-drained queue (the
+        TOCTOU between the closed-check and the put) must still get its
+        future RESOLVED — fail_pending covers the queue AND the carry
+        slot, so no client can block forever on stop()."""
+        b = DynamicBatcher(max_batch=8, max_wait_s=0.01)
+        raced = b.submit(_img(), timeout=0.1)
+        b.submit(_img(6), timeout=0.1)
+        b.submit(_img(5), timeout=0.1)       # 1+6+5 > 8: carried
+        b.next_batch()                        # drains 1+6, carries the 5
+        assert b.fail_pending(ServiceClosed("stopped")) == 1   # the carry
+        b._q.put(raced)                       # simulate the raced put
+        assert b.fail_pending(ServiceClosed("stopped")) == 1
+        with pytest.raises(ServiceClosed):
+            raced.result(timeout=0.1)
+
+
+# ---------------------------------------------------------------------------
+# 3. meter + events
+# ---------------------------------------------------------------------------
+
+class TestServingMeter:
+    def test_window_stats_and_reset(self):
+        m = ServingMeter()
+        t0 = 100.0
+        m.record_batch(rows=6, bucket=8, t_now=t0)
+        for lat in (0.010, 0.020, 0.030):
+            m.record_latency(lat)
+        m.record_enqueue(2)
+        snap = m.snapshot(t0 + 1.0, reset=True)
+        assert snap["requests"] == 3 and snap["batches"] == 1
+        assert snap["fill_ratio"] == pytest.approx(6 / 8)
+        assert snap["p50_ms"] == pytest.approx(20.0)
+        assert snap["queue_depth"] == 2.0
+        assert snap["rows_per_sec"] == pytest.approx(6.0)
+        # window reset: empty stats, lifetime totals kept
+        empty = m.snapshot(t0 + 2.0, reset=False)
+        assert empty["requests"] == 0 and np.isnan(empty["p50_ms"])
+        assert m.total_requests == 3 and m.total_batches == 1
+        # the log line renders NaN windows without crashing
+        assert "serve[" in serve_log_line(empty)
+
+    def test_serve_stats_event_roundtrip(self, tmp_path):
+        from byol_tpu.observability.events import RunLog, read_events
+        m = ServingMeter()
+        m.record_batch(rows=4, bucket=8, t_now=1.0)
+        m.record_latency(0.005)
+        path = str(tmp_path / "serve.jsonl")
+        with RunLog(path) as log:
+            m.emit(log, 2.0, compile_count=3, streams=8)
+            # an EMPTY window must also produce a valid line (NaN
+            # percentiles -> "NaN" strings, still schema-valid)
+            m.emit(log, 3.0)
+        events = list(read_events(path))
+        assert [e["kind"] for e in events] == ["serve_stats", "serve_stats"]
+        assert events[0]["requests"] == 1 and events[0]["compile_count"] == 3
+        assert events[1]["p50_ms"] == "NaN"
+
+
+# ---------------------------------------------------------------------------
+# 4. engine + service on the mesh (one shared model/checkpoint setup)
+# ---------------------------------------------------------------------------
+
+_NUM_CLASSES = 10
+
+
+def _serve_cfg():
+    from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
+                                      TaskConfig)
+    return Config(
+        task=TaskConfig(task="fake", batch_size=16, epochs=2,
+                        image_size_override=16),
+        model=ModelConfig(arch="resnet18", head_latent_size=32,
+                          projection_size=16),
+        device=DeviceConfig(num_replicas=8, half=False, seed=0),
+    )
+
+
+@pytest.fixture(scope="module")
+def served(mesh8, tmp_path_factory):
+    """Train-state on the 8-device mesh -> checkpoint -> serving restore
+    onto a 4-device mesh (FEWER devices than it trained on) -> a built
+    (unstarted) service plus the pieces the tests compare against."""
+    from byol_tpu.checkpoint import CheckpointStore
+    from byol_tpu.core.config import resolve
+    from byol_tpu.parallel.compile_plan import build_plan
+    from byol_tpu.parallel.mesh import MeshSpec, build_mesh
+    from byol_tpu.serving.engine import ServingEngine
+    from byol_tpu.serving.service import (ServeConfig, build_service,
+                                          restore_params_for_serving)
+    from byol_tpu.training.build import build_net, build_tx, init_variables
+    from byol_tpu.training.state import create_train_state
+
+    cfg = _serve_cfg()
+    rcfg = resolve(cfg, num_train_samples=64, num_test_samples=16,
+                   output_size=_NUM_CLASSES, input_shape=(16, 16, 3))
+    net = build_net(rcfg)
+    plan8 = build_plan(mesh8)
+    with mesh8:
+        variables = init_variables(net, rcfg, jax.random.PRNGKey(0))
+        tx, _ = build_tx(rcfg)
+        state = create_train_state(variables, tx)
+    state, _ = plan8.prepare_state(state, tx)
+
+    ckpt_dir = str(tmp_path_factory.mktemp("serve") / "ckpt")
+    store = CheckpointStore(ckpt_dir)
+    store.save(0, plan8.to_canonical(state))   # identity for a replicated
+    store._ckptr.wait_until_finished()         # plan; mesh-size portable
+    store.close()
+
+    mesh4 = build_mesh(MeshSpec(data=4), jax.devices()[:4])
+    net_s, params, batch_stats, epoch = restore_params_for_serving(
+        cfg, ckpt_dir, mesh4, num_classes=_NUM_CLASSES)
+    assert epoch == 0
+    service = build_service(
+        cfg, ServeConfig(min_bucket=8, max_bucket=16, max_wait_ms=2.0,
+                         num_classes=_NUM_CLASSES),
+        checkpoint_dir=ckpt_dir, mesh=mesh4)
+    yield types.SimpleNamespace(
+        cfg=cfg, net=net_s, params=params, batch_stats=batch_stats,
+        service=service, mesh4=mesh4, ckpt_dir=ckpt_dir)
+    service.batcher.close()
+
+
+def _extractor_features(served, images16):
+    """The linear-eval ground truth: extract_features over the SAME
+    restored checkpoint params (the offline-protocol path serving must
+    bitwise-reproduce)."""
+    from byol_tpu.training.linear_eval import (encoder_apply_fn,
+                                               extract_features)
+    state = types.SimpleNamespace(params=served.params,
+                                  batch_stats=served.batch_stats)
+    apply_fn = encoder_apply_fn(served.net, state, half=False,
+                                normalize=False)
+    feats, labels = extract_features(
+        apply_fn,
+        iter([{"view1": images16,
+               "label": np.arange(len(images16), dtype=np.int32)}]))
+    return feats
+
+
+class TestServingCorrectness:
+    def test_served_embeddings_bitwise_match_linear_eval(self, served):
+        """The acceptance pin: batching, bucket padding, data-sharding,
+        donation, and AOT compilation may change WHERE the flops run, but
+        not a single bit of the embeddings the user gets — and the hot
+        path runs clean under the guard_steps transfer guard (explicit
+        device_put/device_get only)."""
+        rng = np.random.RandomState(7)
+        images = rng.rand(16, 16, 16, 3).astype(np.float32)
+        expected = _extractor_features(served, images)
+
+        engine = served.service.engine
+        # exact-fill bucket (16 rows -> bucket 16)
+        got_full = guard_steps(engine.embed)(images)
+        np.testing.assert_array_equal(got_full, expected)
+        # padded bucket (11 rows -> bucket 16, 5 pad rows sliced off):
+        # pad rows must never bleed into real rows
+        got_padded = guard_steps(engine.embed)(images[:11])
+        np.testing.assert_array_equal(got_padded, expected[:11])
+        # and below the floor (3 rows -> bucket 8)
+        got_small = guard_steps(engine.embed)(images[:3])
+        np.testing.assert_array_equal(got_small, expected[:3])
+
+    def test_full_service_roundtrip_matches_too(self, served):
+        """Same pin through the THREADED path: queue -> coalesce ->
+        worker -> futures (the engine test above bypasses the batcher)."""
+        rng = np.random.RandomState(8)
+        images = rng.rand(6, 16, 16, 3).astype(np.float32)
+        expected = _extractor_features(served, images)
+        svc = served.service
+        if svc._thread is None:
+            svc.start(warmup=True)
+        reqs = [svc.submit(images[i]) for i in range(6)]
+        got = np.stack([r.result(timeout=120.0)[0] for r in reqs])
+        np.testing.assert_array_equal(got, expected)
+
+    def test_restored_onto_fewer_devices(self, served):
+        """The checkpoint trained on 8 devices; the serving mesh has 4 —
+        the canonical codec makes that a non-event."""
+        assert len(served.mesh4.devices.flat) == 4
+        assert served.service.engine._plan.num_shards == 4
+
+
+class TestBuildServiceValidation:
+    def test_bad_bucket_config_fails_before_model_build(self, mesh8):
+        """A bucket vocabulary incompatible with the serving mesh must be
+        an immediate, actionable ValueError — not a traceback after the
+        encoder build / checkpoint restore has already been paid."""
+        import time as _time
+
+        from byol_tpu.serving.service import ServeConfig, build_service
+        t0 = _time.perf_counter()
+        with pytest.raises(ValueError, match="multiple of the serving"):
+            build_service(_serve_cfg(),
+                          ServeConfig(min_bucket=4, max_bucket=16),
+                          mesh=mesh8)          # 4 % 8 != 0
+        assert _time.perf_counter() - t0 < 5.0   # pre-build fail-fast
+
+
+class TestCompileDiscipline:
+    def test_compile_count_equals_distinct_buckets_touched(self, served):
+        """Lazy path (no warmup): the engine compiles exactly once per
+        DISTINCT bucket, never per distinct request size."""
+        from byol_tpu.parallel.compile_plan import build_plan
+        from byol_tpu.serving.engine import ServingEngine
+        from byol_tpu.training.linear_eval import frozen_representation_fn
+
+        represent = frozen_representation_fn(
+            served.net, served.params, served.batch_stats,
+            half=False, normalize=False)
+        engine = ServingEngine(
+            represent, build_plan(served.mesh4), input_shape=(16, 16, 3),
+            buckets=BucketSpec(min_bucket=8, max_bucket=16))
+        rng = np.random.RandomState(0)
+        assert engine.compile_count == 0
+        touched = set()
+        for rows in (3, 5, 1, 8, 7):          # all -> bucket 8
+            engine.embed(rng.rand(rows, 16, 16, 3).astype(np.float32))
+            touched.add(engine.buckets.bucket_for(rows))
+        assert engine.compile_count == len(touched) == 1
+        for rows in (9, 16, 12):              # all -> bucket 16
+            engine.embed(rng.rand(rows, 16, 16, 3).astype(np.float32))
+            touched.add(engine.buckets.bucket_for(rows))
+        assert engine.compile_count == len(touched) == 2
+
+    def test_zero_recompiles_after_warmup_steady_state(self, served):
+        """The acceptance pin: a warmed service answers an arbitrary mix
+        of request sizes with the compile counter FROZEN."""
+        svc = served.service
+        if svc._thread is None:
+            svc.start(warmup=True)
+        else:
+            svc.engine.warmup()
+        warm = svc.engine.compile_count
+        assert warm == len(svc.engine.buckets.sizes)
+        rng = np.random.RandomState(1)
+        reqs = [svc.submit(
+                    rng.rand(int(rng.randint(1, 17)), 16, 16, 3)
+                    .astype(np.float32), timeout=10.0)
+                for _ in range(24)]
+        for r in reqs:
+            r.result(timeout=120.0)
+        assert svc.engine.compile_count == warm
+        # and the meter saw it all
+        assert svc.meter.total_requests >= 24
+
+
+class _StubEngine:
+    """Engine double for service-policy tests: instant, jax-free."""
+
+    input_shape = (4, 4, 3)              # matches _img()'s default rows
+
+    def __init__(self, fail_rows=()):
+        self.buckets = BucketSpec(min_bucket=8, max_bucket=16)
+        self.compile_count = len(self.buckets.sizes)
+        self.fail_rows = set(fail_rows)
+
+    def embed(self, rows):
+        if rows.shape[0] in self.fail_rows:
+            raise RuntimeError(f"boom at {rows.shape[0]} rows")
+        return rows.reshape(rows.shape[0], -1)[:, :4].astype(np.float32)
+
+
+class TestServicePolicy:
+    def test_engine_failure_hits_only_that_batch(self):
+        """An embed failure is relayed to the requests in THAT batch;
+        the worker keeps serving the queue behind them."""
+        svc = EmbeddingService(
+            _StubEngine(fail_rows=(2,)),
+            DynamicBatcher(max_batch=16, max_wait_s=0.01))
+        svc.start(warmup=False)
+        bad = [svc.submit(_img()) for _ in range(2)]      # coalesce to 2
+        for r in bad:
+            with pytest.raises(RuntimeError, match="boom"):
+                r.result(timeout=10.0)
+        time.sleep(0.05)                   # let the failed flush clear
+        ok = svc.submit(_img(3))
+        assert ok.result(timeout=10.0).shape == (3, 4)
+        svc.stop()
+
+    def test_stop_drains_accepted_requests(self):
+        svc = EmbeddingService(
+            _StubEngine(), DynamicBatcher(max_batch=16, max_wait_s=0.01))
+        svc.start(warmup=False)
+        reqs = [svc.submit(_img()) for _ in range(5)]
+        svc.stop()
+        for r in reqs:
+            assert r.result(timeout=1.0).shape == (1, 4)
+        with pytest.raises(ServiceClosed):
+            svc.submit(_img())
+
+    def test_result_return_is_a_meter_barrier(self):
+        """By the time result() returns, the request's latency sample is
+        already in the meter — a caller that joins its clients and
+        immediately snapshots (the bench rungs, the CLI smoke) must not
+        race the worker's bookkeeping."""
+        svc = EmbeddingService(
+            _StubEngine(), DynamicBatcher(max_batch=16, max_wait_s=0.001))
+        svc.start(warmup=False)
+        for i in range(5):
+            svc.embed(_img(), timeout=10.0)
+            assert svc.meter.total_requests == i + 1
+        svc.stop()
+
+    def test_mismatched_shape_rejected_in_client_thread(self):
+        """A wrong-sized image is THAT client's ValueError at submit —
+        it must never coalesce with valid requests and kill the worker
+        (which would strand every future behind it)."""
+        svc = EmbeddingService(
+            _StubEngine(), DynamicBatcher(max_batch=16, max_wait_s=0.01))
+        svc.start(warmup=False)
+        with pytest.raises(ValueError, match="do not match"):
+            svc.submit(np.zeros((8, 8, 3), np.float32))
+        # the worker is alive and serving
+        assert svc.embed(_img(), timeout=10.0).shape == (1, 4)
+        svc.stop()
+
+    def test_stop_racing_submits_strands_no_future(self):
+        """Hammer close() against concurrent submitters: every Request a
+        submit RETURNED must resolve (result or ServiceClosed) — the
+        close-lock + fail_pending contract under real contention."""
+        svc = EmbeddingService(
+            _StubEngine(), DynamicBatcher(max_batch=16, max_wait_s=0.001))
+        svc.start(warmup=False)
+        accepted, lock = [], threading.Lock()
+
+        def spam():
+            while True:
+                try:
+                    req = svc.submit(_img(), timeout=0.05)
+                except ServiceClosed:
+                    return
+                except Exception:
+                    continue        # Backpressure: retry
+                with lock:
+                    accepted.append(req)
+
+        threads = [threading.Thread(target=spam) for _ in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        svc.stop()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert accepted
+        for req in accepted:
+            try:
+                out = req.result(timeout=1.0)   # must NOT TimeoutError
+                assert out.shape == (1, 4)
+            except ServiceClosed:
+                pass                            # refused is resolved too
+
+    def test_padded_result_owns_its_rows(self, served):
+        """engine.embed's padded-bucket result is a COPY, not a view of
+        the full (bucket, D) buffer — a held single-row result must not
+        pin bucket-times its own memory."""
+        rng = np.random.RandomState(9)
+        out = served.service.engine.embed(
+            rng.rand(3, 16, 16, 3).astype(np.float32))   # bucket 8, n=3
+        assert out.base is None
+
+    def test_concurrent_streams_all_answered(self):
+        svc = EmbeddingService(
+            _StubEngine(), DynamicBatcher(max_batch=16, max_wait_s=0.002))
+        svc.start(warmup=False)
+        done = []
+        lock = threading.Lock()
+
+        def stream(n):
+            for _ in range(n):
+                out = svc.embed(_img(), timeout=30.0)
+                with lock:
+                    done.append(out.shape)
+
+        threads = [threading.Thread(target=stream, args=(10,))
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        svc.stop()
+        assert len(done) == 80 and set(done) == {(1, 4)}
+        assert svc.meter.total_requests == 80

@@ -258,7 +258,10 @@ def test_plan_describe_is_the_run_header_record(mesh8):
     assert d["zero1"] == "on"
     assert d["donate_argnums"]["train_step"] == [0]
     assert set(d["donate_argnums"]) == {
-        "train_step", "eval_step", "encoder_extractor", "spmd_extractor"}
+        "train_step", "eval_step", "encoder_extractor", "spmd_extractor",
+        "serve_step"}
+    # the serving hot path donates its staged request batch (ISSUE 8)
+    assert d["donate_argnums"]["serve_step"] == [0]
     json.dumps(d)                       # header-embeddable as-is
     assert build_plan(mesh8).describe()["zero1"] == "off"
 

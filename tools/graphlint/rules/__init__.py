@@ -5,6 +5,7 @@ from typing import List
 
 from tools.graphlint.engine import Rule
 from tools.graphlint.rules.cli_drift import CliDriftRule
+from tools.graphlint.rules.collective_axes import CollectiveAxesRule
 from tools.graphlint.rules.donate import DonateRule
 from tools.graphlint.rules.host_sync import HostSyncRule
 from tools.graphlint.rules.prng import PRNGReuseRule
@@ -16,4 +17,4 @@ from tools.graphlint.rules.sharding_axes import ShardingAxesRule
 def all_rules() -> List[Rule]:
     return [HostSyncRule(), RecompileRule(), PRNGReuseRule(),
             DonateRule(), RematTagRule(), CliDriftRule(),
-            ShardingAxesRule()]
+            ShardingAxesRule(), CollectiveAxesRule()]

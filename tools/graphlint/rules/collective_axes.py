@@ -1,0 +1,153 @@
+"""GL108 — collective over an axis name nothing binds (rule-wave-2(b)).
+
+``lax.psum(x, 'batch')`` inside a function that is vmapped with
+``axis_name='i'`` does not fail where the mismatch was written: the
+collective traces fine, and the unbound-axis ``NameError`` surfaces at the
+eventual ``vmap``/``shard_map``/``pmap`` call site — often another module,
+under a jit, mid-run.  Worse, after a refactor renames the vmap's
+``axis_name`` but not the collectives inside, every call site becomes a
+latent trace error that only fires when that code path is exercised.
+
+Approach (module-local engine, cross-file vocabulary — the GL107 pattern):
+
+- **phase 1** collects every axis name the lint set can BIND: literal /
+  module-constant ``axis_name=`` arguments of ``jax.vmap`` / ``jax.pmap``
+  / ``flax.linen.vmap``, mesh axes declared by the parallel/ modules
+  (``*_AXIS`` string constants and ``AXIS_NAMES`` tuples — ``shard_map``
+  and GSPMD bind those), and ``nn.BatchNorm(axis_name=...)``-style
+  resolvable bindings;
+- **phase 2** judges each collective call (``psum``/``pmean``/``pmax``/
+  ``pmin``/``psum_scatter``/``all_gather``/``all_to_all``/``ppermute``/
+  ``axis_index``) whose axis operand RESOLVES to a string (literal or
+  module constant): an axis outside the bound vocabulary is a finding.
+
+Zero-false-positive contract: an axis operand the linter cannot resolve (a
+function parameter — the collectives.py wrappers) is left alone, and when
+the lint set binds no axes at all the rule stands down (a partial
+``--select`` sweep of one file must not guess).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tools.graphlint.astutil import module_str_constants, qualname
+from tools.graphlint.engine import Context, Finding, LintedFile, Rule
+
+# collective -> positional index of its axis-name operand
+_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "axis_index": 0,
+}
+# prefixes a collective can be spelled through
+_LAX_PREFIXES = ("jax.lax.", "lax.", "jax.")
+
+# axis-BINDING callables: axis_name= here enters the vocabulary
+_BINDERS = {"jax.vmap", "jax.pmap", "vmap", "pmap", "flax.linen.vmap",
+            "nn.vmap", "flax.linen.BatchNorm", "nn.BatchNorm"}
+
+
+def _collective_name(q: str) -> str | None:
+    for prefix in _LAX_PREFIXES:
+        if q.startswith(prefix) and q[len(prefix):] in _COLLECTIVES:
+            return q[len(prefix):]
+    return q if q in _COLLECTIVES else None
+
+
+class _Store:
+    def __init__(self) -> None:
+        # axis value -> (file, line) of a binding site
+        self.bound: Dict[str, Tuple[str, int]] = {}
+
+
+def _store(ctx: Context) -> _Store:
+    return ctx.store.setdefault("collective_axes", _Store())
+
+
+def _resolve_axes(node: ast.AST, consts: Dict[str, str]) -> List[str]:
+    """Axis names a spec operand resolves to; [] when unresolvable.
+    Handles the tuple form ``psum(x, ('i', 'j'))`` by flattening."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in node.elts:
+            out.extend(_resolve_axes(e, consts))
+        return out
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.Name) and node.id in consts:
+        return [consts[node.id]]
+    return []
+
+
+class CollectiveAxesRule(Rule):
+    id = "GL108"
+    name = "collective-axis-unbound"
+    doc = ("psum/pmean/all_gather/... over an axis name no vmap/shard_map/"
+           "mesh in the lint set binds — fails far from where it was "
+           "written")
+
+    # ------------------------------------------------------------- phase 1
+    def collect(self, f: LintedFile, ctx: Context) -> None:
+        st = _store(ctx)
+        consts = module_str_constants(f.tree)
+        # mesh axes: the parallel/ declarations (shard_map / GSPMD bind
+        # them at runtime) — same vocabulary discipline as GL107
+        if "parallel/" in f.rel.replace("\\", "/"):
+            for name, value in consts.items():
+                if name.endswith("_AXIS"):
+                    st.bound.setdefault(value, (f.rel, 0))
+        for stmt in f.tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "AXIS_NAMES"
+                    and isinstance(stmt.value, (ast.Tuple, ast.List))):
+                for e in stmt.value.elts:
+                    for axis in _resolve_axes(e, consts):
+                        st.bound.setdefault(axis, (f.rel, stmt.lineno))
+        # vmap/pmap/BatchNorm axis_name= bindings with resolvable values
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = qualname(node.func, f.imports)
+            if not q or q not in _BINDERS:
+                continue
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "bn_axis_name"):
+                    for axis in _resolve_axes(kw.value, consts):
+                        st.bound.setdefault(axis, (f.rel, node.lineno))
+
+    # ------------------------------------------------------------- phase 2
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        st = _store(ctx)
+        if not st.bound:
+            return []        # partial sweep bound nothing: stand down
+        consts = module_str_constants(f.tree)
+        findings: List[Finding] = []
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = qualname(node.func, f.imports)
+            coll = _collective_name(q) if q else None
+            if coll is None:
+                continue
+            idx = _COLLECTIVES[coll]
+            operand = None
+            if len(node.args) > idx:
+                operand = node.args[idx]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        operand = kw.value
+            if operand is None:
+                continue
+            for axis in _resolve_axes(operand, consts):
+                if axis in st.bound:
+                    continue
+                bound: Set[str] = set(st.bound)
+                findings.append(self.finding(
+                    f, node, f"lax.{coll} over axis {axis!r}, which no "
+                    f"vmap/pmap axis_name or declared mesh axis binds "
+                    f"(bound: {sorted(bound)}) — the unbound-axis error "
+                    "will fire at the transform call site, far from "
+                    "this line"))
+        return findings
