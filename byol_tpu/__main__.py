@@ -1,9 +1,12 @@
-"""``python -m byol_tpu [serve] ...`` — train by default, serve on demand.
+"""``python -m byol_tpu [serve|report] ...`` — train by default.
 
 Subcommand dispatch lives here (not in cli.py) so the training surface
 keeps its reference-mirroring flag-only interface: ``python -m byol_tpu
 --task cifar10 ...`` trains exactly as before, ``python -m byol_tpu serve
---checkpoint ...`` stands up the embedding service (byol_tpu/serving/).
+--checkpoint ...`` stands up the embedding service (byol_tpu/serving/),
+and ``python -m byol_tpu report <run.jsonl>`` renders the offline goodput
+/ step-time / serving / anomaly analysis from an event log alone
+(observability/report.py — no live process or accelerator needed).
 """
 import sys
 
@@ -13,6 +16,9 @@ def main() -> int:
     if argv and argv[0] == "serve":
         from byol_tpu.serving.cli import main as serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == "report":
+        from byol_tpu.observability.report import main as report_main
+        return report_main(argv[1:])
     from byol_tpu.cli import main as train_main
     return train_main(argv)
 

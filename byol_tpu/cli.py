@@ -133,6 +133,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "telemetry health vector: 'warn' records an "
                         "anomaly event; 'halt' dumps step/state metadata "
                         "to the run log and raises")
+    d.add_argument("--spans", type=str, default="on",
+                   choices=("on", "off"),
+                   help="host-side span flight recorder "
+                        "(observability/spans.py): 'on' times every "
+                        "hot-loop phase (input wait, dispatch, readback, "
+                        "eval, checkpoint, compile), emits goodput/"
+                        "span_stats events into run.jsonl and writes a "
+                        "Chrome-trace trace.json per run (< 2% overhead, "
+                        "bench --spans-ab); 'off' records nothing")
     d.add_argument("--fault-at-step", type=int, default=0,
                    help="fault injection: kill the process at step N "
                         "(tests checkpoint/resume)")
@@ -336,6 +345,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             telemetry=args.telemetry,
             telemetry_interval=args.telemetry_interval,
             nan_policy=args.nan_policy,
+            spans=args.spans,
             fault_at_step=args.fault_at_step,
             save_on_signal=args.save_on_signal,
             watchdog_timeout=args.watchdog_timeout,

@@ -185,6 +185,14 @@ class DeviceConfig:
     nan_policy: str = "warn"            # non-finite grads/loss response:
                                         # 'warn' (anomaly event) | 'halt'
                                         # (state-dump event + raise)
+    spans: str = "on"                   # host-side flight recorder
+                                        # (observability/spans.py): 'on'
+                                        # records hot-loop phase spans +
+                                        # goodput/span_stats events + a
+                                        # Chrome trace per run (< 2%
+                                        # overhead, bench --spans-ab);
+                                        # 'off' hands the hot loop a
+                                        # shared no-op (records nothing)
     fault_at_step: int = 0              # >0: kill the process at step N to
                                         # exercise preemption/resume paths
     save_on_signal: bool = True         # SIGTERM (pod preemption notice) ->
@@ -339,6 +347,9 @@ def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
         raise ValueError(
             f"unknown nan_policy {cfg.device.nan_policy!r}; "
             "'warn' | 'halt'")
+    if cfg.device.spans not in ("on", "off"):
+        raise ValueError(
+            f"unknown spans mode {cfg.device.spans!r}; 'on' | 'off'")
     if cfg.device.zero1 not in ("off", "on"):
         raise ValueError(
             f"unknown zero1 mode {cfg.device.zero1!r}; 'off' | 'on'")

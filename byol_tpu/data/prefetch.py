@@ -75,15 +75,22 @@ def host_nbytes(batch) -> int:
 
 
 def prefetch_to_mesh(iterator: Iterator, mesh: Mesh, size: int = 2,
-                     meter: Optional[InputPipelineMeter] = None
-                     ) -> Iterator:
+                     meter: Optional[InputPipelineMeter] = None,
+                     recorder=None) -> Iterator:
     """Yield device-resident batches, keeping up to ``size`` in flight.
 
     ``meter`` (observability.meters.InputPipelineMeter): when given, the
     producer records each batch's host-byte payload + the queue depth it
     leaves, and the consumer records its blocking wait for the next batch
     (time-to-next-batch / starvation) — the input-pipeline health surface
-    the trainer prints per epoch."""
+    the trainer prints per epoch.
+
+    ``recorder`` (observability.spans.SpanRecorder): when given, each
+    consumer wait becomes an ``input/fill`` (first batch) or ``input/wait``
+    span — the flight-recorder twin of the meter's aggregate, attributed
+    to the ``input_wait`` goodput bucket.  The spans open in the CONSUMER
+    thread (this generator's caller), so they never overlap the trainer's
+    other top-level spans."""
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
     # ``slots`` — not the queue's maxsize — is what bounds device residency:
@@ -121,11 +128,15 @@ def prefetch_to_mesh(iterator: Iterator, mesh: Mesh, size: int = 2,
     thread = threading.Thread(target=produce, name="prefetch_to_mesh",
                               daemon=True)
     thread.start()
+    if recorder is None:
+        from byol_tpu.observability import spans as spans_lib
+        recorder = spans_lib.NULL
     try:
         first = True
         while True:
             t0 = time.perf_counter() if meter is not None else 0.0
-            item = q.get()
+            with recorder.span("input/fill" if first else "input/wait"):
+                item = q.get()
             if item is _END:
                 return
             if isinstance(item, _Failure):

@@ -2,8 +2,9 @@ from byol_tpu.observability.grapher import Grapher, make_grid
 from byol_tpu.observability.meters import (InputPipelineMeter,
                                            MetricAccumulator, StepTimer,
                                            epoch_log_line, input_log_line)
-from byol_tpu.observability import events, flops, health, profiling, telemetry
+from byol_tpu.observability import (events, flops, goodput, health,
+                                    profiling, spans, telemetry)
 
 __all__ = ["Grapher", "make_grid", "InputPipelineMeter", "MetricAccumulator",
            "StepTimer", "epoch_log_line", "input_log_line", "events",
-           "flops", "health", "profiling", "telemetry"]
+           "flops", "goodput", "health", "profiling", "spans", "telemetry"]

@@ -53,8 +53,16 @@ EVENT_KINDS: Dict[str, tuple] = {
     # serving/meter.py window snapshot: request count, coalesced-batch
     # count, and the latency tail — the serving analog of "step"/"epoch".
     # Additive kind (no SCHEMA_VERSION bump); optional payload carries
-    # fill ratio, queue depth, and the engine compile counter.
+    # fill ratio, queue depth, the engine compile counter, and the
+    # per-request lifecycle phase breakdown (``phase_ms``).
     "serve_stats": ("requests", "batches", "p50_ms", "p99_ms"),
+    # observability/goodput.py wall-time partition (additive kinds):
+    # one ``goodput`` event per epoch window + one run-scope total;
+    # ``span_stats`` carries the window's per-span-name aggregates
+    # (count / total seconds / p50 / p99 / max).  The partition identity
+    # — productive + sum(badput) == wall — is validated below.
+    "goodput": ("scope", "wall_seconds", "productive_seconds", "badput"),
+    "span_stats": ("scope", "spans"),
     "run_end": (),
 }
 
@@ -139,6 +147,27 @@ def validate_event(event: Any) -> Dict[str, Any]:
             raise ValueError(
                 f"run_header.sharding_plan.zero1 must be 'off'|'on', got "
                 f"{sp.get('zero1')!r}")
+    if kind == "goodput":
+        bp = event["badput"]
+        if not isinstance(bp, dict):
+            raise ValueError(
+                f"goodput.badput must be an object of bucket seconds, got "
+                f"{type(bp).__name__}")
+        vals = [event["wall_seconds"], event["productive_seconds"],
+                *bp.values()]
+        if all(isinstance(v, (int, float)) and not isinstance(v, bool)
+               for v in vals):
+            # the accounting identity the whole feature exists to provide:
+            # the partition must SUM to wall time (1% tolerance covers the
+            # reader-side float round-trip; the writer computes it exactly)
+            wall = float(event["wall_seconds"])
+            total = (float(event["productive_seconds"])
+                     + sum(float(v) for v in bp.values()))
+            if abs(total - wall) > max(0.01 * abs(wall), 1e-6):
+                raise ValueError(
+                    f"goodput buckets sum to {total:.6f}s but wall is "
+                    f"{wall:.6f}s (off by more than 1%): the partition "
+                    "must be exhaustive (goodput.py fold contract)")
     return event
 
 

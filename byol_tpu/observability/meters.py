@@ -9,6 +9,8 @@ metric the reference never measured (SURVEY.md §5.1).
 """
 from __future__ import annotations
 
+import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 import jax
@@ -173,6 +175,9 @@ class StepTimer:
         self._rate = 0.0
         self._flops_per_sample: Optional[float] = None
         self._peak_tflops: Optional[float] = None
+        # per-epoch dispatch timestamps for the step-time tail (bounded:
+        # a pathological epoch must not grow host memory without limit)
+        self._ticks: "deque[float]" = deque(maxlen=1 << 16)
 
     def set_flops(self, flops_per_sample: Optional[float],
                   peak_tflops: Optional[float]) -> None:
@@ -195,3 +200,31 @@ class StepTimer:
     def images_per_sec_per_chip(self) -> float:
         """Most recent epoch's rate (0.0 before the first epoch ends)."""
         return self._rate
+
+    # ---- step-time tail ---------------------------------------------------
+    def tick(self) -> None:
+        """Stamp one optimizer-step dispatch (one deque append — safe in
+        the hot loop).  Consecutive tick intervals are DISPATCH-to-dispatch
+        times: while the host runs ahead they understate true step time,
+        but once the device queue applies backpressure they converge to
+        it — the same signal the telemetry step_time_spike rule uses, and
+        the only per-step timing a host can take without a sync.  The
+        epoch MEAN stays the honest readback-synced number (record_epoch);
+        these quantiles add the TAIL (p50/p99) that the mean hides."""
+        self._ticks.append(time.perf_counter())
+
+    def reset_ticks(self) -> None:
+        """Start a fresh epoch window (epoch boundaries span eval/
+        checkpoint — their gap must not pollute the next epoch's tail)."""
+        self._ticks.clear()
+
+    def epoch_step_quantiles(self) -> Optional[Dict[str, float]]:
+        """p50/p99/max of this epoch's dispatch intervals, or None below
+        3 intervals (a tail over one or two samples is noise, and the
+        debug_step smoke has only one dispatch per epoch)."""
+        if len(self._ticks) < 4:
+            return None
+        d = np.diff(np.asarray(self._ticks, np.float64))
+        return {"step_time_p50_s": float(np.percentile(d, 50)),
+                "step_time_p99_s": float(np.percentile(d, 99)),
+                "step_time_max_s": float(d.max())}

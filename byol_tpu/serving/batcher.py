@@ -26,12 +26,26 @@ function, not just the embed path.
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
+
+# Per-request lifecycle phases, in causal order.  Every completed request
+# carries a monotonic-clock stamp for each (``Request.marks``): enqueue is
+# stamped at submit, coalesce when its batch flushes, stage/dispatch/
+# readback by the engine (batch-level, copied onto every member), deliver
+# just before the future resolves.  serving/meter.py folds consecutive
+# deltas into the ``phase_ms`` breakdown of ``serve_stats`` events.
+LIFECYCLE_PHASES = ("enqueue", "coalesce", "stage", "dispatch",
+                    "readback", "deliver")
+
+# process-wide trace ids: the correlation key that follows one request
+# through batcher -> engine spans -> future (span ``trace_ids`` attrs)
+_TRACE_IDS = itertools.count(1)
 
 
 class Backpressure(RuntimeError):
@@ -49,6 +63,8 @@ class Request:
         self.images = images
         self.rows = int(images.shape[0])
         self.enqueued_at = time.perf_counter()
+        self.trace_id = next(_TRACE_IDS)
+        self.marks: Dict[str, float] = {"enqueue": self.enqueued_at}
         self._done = threading.Event()
         self._result: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
@@ -78,6 +94,26 @@ class Request:
 
     def latency(self, t_now: float) -> float:
         return t_now - self.enqueued_at
+
+    def mark(self, phase: str, t: Optional[float] = None) -> None:
+        """Stamp one lifecycle phase (perf_counter clock)."""
+        self.marks[phase] = time.perf_counter() if t is None else t
+
+    def lifecycle(self) -> Dict[str, float]:
+        """Phase durations (seconds) between consecutive STAMPED phases —
+        the per-request latency breakdown.  A completed request covers
+        the full LIFECYCLE_PHASES chain; a failed one carries whatever
+        phases it reached."""
+        out: Dict[str, float] = {}
+        prev: Optional[float] = None
+        for phase in LIFECYCLE_PHASES:
+            t = self.marks.get(phase)
+            if t is None:
+                continue
+            if prev is not None:
+                out[phase] = t - prev
+            prev = t
+        return out
 
 
 class DynamicBatcher:
@@ -213,4 +249,10 @@ class DynamicBatcher:
                 break
             batch.append(nxt)
             rows += nxt.rows
+        # the batch is final: stamp every member's coalesce phase with ONE
+        # clock read (enqueue -> coalesce = queue wait + coalesce wait,
+        # the batching policy's contribution to that request's latency)
+        t_flush = time.perf_counter()
+        for r in batch:
+            r.mark("coalesce", t_flush)
         return batch

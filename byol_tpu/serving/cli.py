@@ -65,6 +65,13 @@ def build_serve_parser():
     s.add_argument("--serve-events", type=str, default="",
                    help="serve_stats JSONL path (default "
                         "<log_dir>/serve.jsonl)")
+    s.add_argument("--serve-trace", type=str, default="",
+                   help="Chrome-trace JSON written at shutdown from the "
+                        "serving flight recorder (per-batch spans with "
+                        "request trace ids + engine stage/dispatch/"
+                        "readback; observability/spans.py); default "
+                        "<log_dir>/serve_trace.json, 'off' disables "
+                        "recording entirely")
     s.add_argument("--smoke", type=int, default=0,
                    help="drive N synthetic requests through the service, "
                         "print stats, exit (CI smoke)")
@@ -127,6 +134,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     from byol_tpu.cli import config_from_args
+    from byol_tpu.observability import spans as spans_lib
     from byol_tpu.observability.events import RunLog
     from byol_tpu.serving.meter import serve_log_line
     from byol_tpu.serving.service import ServeConfig, build_service
@@ -139,6 +147,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         stats_interval_s=args.stats_interval)
     events_path = args.serve_events or os.path.join(cfg.task.log_dir,
                                                     "serve.jsonl")
+    trace_path = args.serve_trace or os.path.join(cfg.task.log_dir,
+                                                  "serve_trace.json")
+    recorder = (spans_lib.NULL if args.serve_trace == "off"
+                else spans_lib.SpanRecorder())
+
+    def _export_trace() -> None:
+        if not recorder.enabled:
+            return
+        try:
+            n = spans_lib.export_chrome_trace(recorder.records(),
+                                              trace_path,
+                                              process_name="byol_serve")
+            print(f"serve: wrote {n} span(s) to {trace_path}",
+                  file=sys.stderr)
+        except OSError as e:   # evidence, never a reason to fail shutdown
+            print(f"serve: trace export failed ({e!r})", file=sys.stderr)
+
     with RunLog(events_path, best_effort=True) as events:
         import jax
         events.emit("run_header",
@@ -153,7 +178,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     backend=jax.default_backend())
         service = build_service(cfg, serve_cfg,
                                 checkpoint_dir=args.checkpoint,
-                                best=args.restore_best, events=events)
+                                best=args.restore_best, events=events,
+                                recorder=recorder)
         if not args.checkpoint:
             print("serve: no --checkpoint given — serving a RANDOM-init "
                   "encoder (embeddings are meaningless; smoke/bench "
@@ -174,6 +200,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 snap = service.meter.snapshot(time.perf_counter(),
                                               reset=False)
                 service.stop()
+                _export_trace()
                 print(serve_log_line(snap))
                 if done != args.smoke:
                     print(f"serve: smoke completed {done}/{args.smoke} "
@@ -193,6 +220,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         finally:
             if args.smoke == 0:
                 service.stop()
+                _export_trace()
                 events.emit("run_end",
                             compile_count=service.engine.compile_count)
     return 0

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from byol_tpu.observability import spans as spans_lib
 from byol_tpu.serving.buckets import BucketSpec
 
 
@@ -43,7 +44,8 @@ class ServingEngine:
     def __init__(self, represent_fn: Callable, plan: Any,
                  input_shape: Tuple[int, int, int],
                  buckets: BucketSpec,
-                 input_dtype: np.dtype = np.float32) -> None:
+                 input_dtype: np.dtype = np.float32,
+                 recorder: Any = None) -> None:
         n = plan.num_shards
         if buckets.min_bucket % n != 0:
             raise ValueError(
@@ -60,6 +62,11 @@ class ServingEngine:
         self._staging: Dict[int, np.ndarray] = {}
         self.compile_count = 0
         self.compile_seconds: Dict[int, float] = {}
+        # flight recorder (observability/spans.py): stage/dispatch/
+        # readback spans per embed, compile spans at warmup — the serving
+        # twin of the trainer's hot-loop instrumentation.  Defaults to the
+        # no-op NULL recorder (records nothing).
+        self._recorder = recorder if recorder is not None else spans_lib.NULL
         self._pinned = self._probe_pinned_host()
 
     # ---- staging ----------------------------------------------------------
@@ -109,7 +116,8 @@ class ServingEngine:
         struct = jax.ShapeDtypeStruct((bucket,) + self.input_shape,
                                       self.input_dtype)
         t0 = time.perf_counter()
-        with self._mesh:
+        with self._recorder.span("startup/compile", bucket=bucket), \
+                self._mesh:
             exe = self._jitted.lower(struct).compile()
         self.compile_seconds[bucket] = time.perf_counter() - t0
         self._executables[bucket] = exe
@@ -125,25 +133,41 @@ class ServingEngine:
                 self._compile(b)
 
     # ---- the hot path -----------------------------------------------------
-    def embed(self, rows: np.ndarray) -> np.ndarray:
+    def embed(self, rows: np.ndarray,
+              timeline: Optional[Dict[str, float]] = None) -> np.ndarray:
         """``(n, H, W, C)`` request rows -> ``(n, D)`` fp32 embeddings.
 
         Pads to the row count's bucket, runs that bucket's executable
         (compiling it first only if warmup never touched it), and slices
         the pad rows back off.  The readback blocks — the worker's batch
         cadence IS the serving cadence, there is nothing to run ahead to.
+
+        ``timeline``, when given, receives the batch-level lifecycle
+        stamps (perf_counter absolutes): ``stage`` after the H2D transfer,
+        ``dispatch`` after the executable call returns, ``readback`` after
+        the D2H completes — the service copies them onto every request in
+        the batch (batcher.LIFECYCLE_PHASES).
         """
         n = rows.shape[0]
         bucket = self.buckets.bucket_for(n)
         exe = self._executables.get(bucket)
         if exe is None:
             exe = self._compile(bucket)
-        staged = self._stage(rows, bucket)
-        out = exe(staged)
+        with self._recorder.span("serve/stage", bucket=bucket, rows=n):
+            staged = self._stage(rows, bucket)
+        if timeline is not None:
+            timeline["stage"] = time.perf_counter()
+        with self._recorder.span("serve/dispatch", bucket=bucket):
+            out = exe(staged)
+        if timeline is not None:
+            timeline["dispatch"] = time.perf_counter()
         # EXPLICIT readback (device_get, not np.asarray): the embed path
         # runs clean under jax.transfer_guard("disallow") — any IMPLICIT
         # transfer in here is a bug the guard_steps test would catch.
-        host = jax.device_get(out)
+        with self._recorder.span("serve/readback", bucket=bucket):
+            host = jax.device_get(out)
+        if timeline is not None:
+            timeline["readback"] = time.perf_counter()
         # copy when padded: a [:n] VIEW would pin the full (bucket, D)
         # buffer for as long as any caller holds the result
         return host[:n] if n == bucket else host[:n].copy()

@@ -52,6 +52,11 @@ class ServingMeter:
         self._depth_sum = 0         # queue depth sampled at each enqueue
         self._depth_samples = 0
         self._window_start = None   # first record in the current window
+        # per-request lifecycle phase sums (batcher.LIFECYCLE_PHASES
+        # deltas: coalesce/stage/dispatch/readback/deliver) — the latency
+        # BREAKDOWN behind the p50/p99 headline
+        self._phase_s: Dict[str, float] = {}
+        self._phase_requests = 0
         # lifetime totals (never reset): the run_end summary
         self.total_requests = 0
         self.total_batches = 0
@@ -77,6 +82,15 @@ class ServingMeter:
             self._latencies.append(float(seconds))
             self._requests += 1
             self.total_requests += 1
+
+    def record_lifecycle(self, phases: Dict[str, float]) -> None:
+        """Accumulate one request's phase-duration dict
+        (``Request.lifecycle()``) into the window's breakdown."""
+        with self._lock:
+            for phase, seconds in phases.items():
+                self._phase_s[phase] = (self._phase_s.get(phase, 0.0)
+                                        + float(seconds))
+            self._phase_requests += 1
 
     # ---- readout ----------------------------------------------------------
     def snapshot(self, t_now: float, *, reset: bool = True
@@ -108,11 +122,20 @@ class ServingMeter:
                 "rows_per_sec": (self._rows / elapsed
                                  if elapsed > 0 else float("nan")),
             }
+            if self._phase_requests:
+                # mean per-request phase durations: where inside the p50
+                # the time actually goes (queue+coalesce wait vs staging
+                # vs device vs delivery) — additive serve_stats field
+                out["phase_ms"] = {
+                    k: _ms(v / self._phase_requests)
+                    for k, v in sorted(self._phase_s.items())}
             if reset:
                 self._latencies.clear()
                 self._requests = self._rows = self._batches = 0
                 self._bucket_rows = 0
                 self._depth_sum = self._depth_samples = 0
+                self._phase_s = {}
+                self._phase_requests = 0
                 self._window_start = None
             return out
 

@@ -28,6 +28,7 @@ from typing import Any, Optional, Tuple
 
 import numpy as np
 
+from byol_tpu.observability import spans as spans_lib
 from byol_tpu.serving.batcher import DynamicBatcher, Request
 from byol_tpu.serving.buckets import BucketSpec
 from byol_tpu.serving.engine import ServingEngine
@@ -35,16 +36,26 @@ from byol_tpu.serving.meter import ServingMeter
 
 
 class EmbeddingService:
-    """Batcher + engine + meter under one worker thread."""
+    """Batcher + engine + meter under one worker thread.
+
+    ``recorder`` (observability.spans.SpanRecorder, optional): the worker
+    wraps each coalesced batch in a ``serve/batch`` span carrying the
+    member requests' trace ids, and the engine nests stage/dispatch/
+    readback spans inside it — so one trace id follows a request from
+    ``submit`` through the engine to its future, and the exported Chrome
+    trace shows the full lifecycle.  Defaults to the no-op NULL recorder.
+    """
 
     def __init__(self, engine: ServingEngine, batcher: DynamicBatcher,
                  *, meter: Optional[ServingMeter] = None,
                  events: Optional[Any] = None,
-                 stats_interval_s: float = 10.0) -> None:
+                 stats_interval_s: float = 10.0,
+                 recorder: Any = None) -> None:
         self.engine = engine
         self.batcher = batcher
         self.meter = meter if meter is not None else ServingMeter()
         self.events = events
+        self.recorder = recorder if recorder is not None else spans_lib.NULL
         self.stats_interval_s = stats_interval_s
         self._thread: Optional[threading.Thread] = None
         self._last_stats = time.perf_counter()
@@ -123,14 +134,21 @@ class EmbeddingService:
             batch = self.batcher.next_batch()
             if batch is None:
                 return
+            timeline: dict = {}
             try:
                 # assembly INSIDE the relay: any per-batch failure —
                 # including one the submit-time validation did not
                 # foresee — belongs to this batch's futures, never to
-                # the worker thread (whose death would strand the queue)
-                rows = (batch[0].images if len(batch) == 1 else
-                        np.concatenate([r.images for r in batch], axis=0))
-                embeddings = self.engine.embed(rows)
+                # the worker thread (whose death would strand the queue).
+                # The serve/batch span carries the members' trace ids;
+                # the engine's stage/dispatch/readback spans nest inside.
+                with self.recorder.span(
+                        "serve/batch",
+                        trace_ids=[r.trace_id for r in batch]):
+                    rows = (batch[0].images if len(batch) == 1 else
+                            np.concatenate([r.images for r in batch],
+                                           axis=0))
+                    embeddings = self.engine.embed(rows, timeline=timeline)
             except Exception as e:  # noqa: BLE001 — relayed per request
                 for r in batch:
                     r.set_error(e)
@@ -141,11 +159,18 @@ class EmbeddingService:
                 t_now)
             lo = 0
             for r in batch:
+                # lifecycle completion BEFORE set_result (same barrier
+                # contract as the latency sample below): a client waking
+                # from result() must find its request's full
+                # enqueue -> deliver chain stamped and already counted
+                r.marks.update(timeline)
+                r.mark("deliver", t_now)
                 # latency recorded BEFORE set_result: a client returning
                 # from result() (e.g. the bench rung joining its streams
                 # and snapshotting the meter) must find its own sample
                 # already counted — recording after would race the reader
                 self.meter.record_latency(r.latency(t_now))
+                self.meter.record_lifecycle(r.lifecycle())
                 # per-request COPY, not a view: a client holding one
                 # request's rows must not pin the whole batch's buffer
                 # for its lifetime
@@ -260,7 +285,8 @@ def _serving_rcfg(cfg, num_classes: int):
 def build_service(cfg, serve_cfg: ServeConfig, *,
                   checkpoint_dir: str = "", mesh=None, best: bool = False,
                   epoch: Optional[int] = None,
-                  events: Optional[Any] = None) -> EmbeddingService:
+                  events: Optional[Any] = None,
+                  recorder: Optional[Any] = None) -> EmbeddingService:
     """Config (+ optional checkpoint) -> a constructed (NOT started)
     EmbeddingService on ``mesh`` (default: all visible devices on the
     data axis).
@@ -268,6 +294,10 @@ def build_service(cfg, serve_cfg: ServeConfig, *,
     ``checkpoint_dir=""`` serves a RANDOM-init encoder — meaningless
     embeddings, identical compute: the smoke/bench path (latency does not
     depend on parameter values, and CI has no trained checkpoint).
+
+    ``recorder`` threads one span flight recorder through engine and
+    worker (serve/batch + stage/dispatch/readback spans with trace ids);
+    the serving CLI exports it as a Chrome trace on shutdown.
     """
     import jax
 
@@ -310,9 +340,10 @@ def build_service(cfg, serve_cfg: ServeConfig, *,
         normalize=cfg.parity.normalize_inputs)
     plan = build_plan(mesh)
     engine = ServingEngine(represent, plan, input_shape=rcfg.input_shape,
-                           buckets=buckets)
+                           buckets=buckets, recorder=recorder)
     batcher = DynamicBatcher(max_batch=serve_cfg.max_bucket,
                              max_queue=serve_cfg.max_queue,
                              max_wait_s=serve_cfg.max_wait_ms / 1e3)
     return EmbeddingService(engine, batcher, events=events,
-                            stats_interval_s=serve_cfg.stats_interval_s)
+                            stats_interval_s=serve_cfg.stats_interval_s,
+                            recorder=recorder)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import signal
+import sys
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -40,6 +41,8 @@ from byol_tpu.observability import (Grapher, InputPipelineMeter,
                                     MetricAccumulator, StepTimer,
                                     epoch_log_line, input_log_line,
                                     profiling)
+from byol_tpu.observability import goodput as goodput_lib
+from byol_tpu.observability import spans as spans_lib
 from byol_tpu.observability.events import RunLog
 from byol_tpu.observability.telemetry import NanHaltError, TelemetrySink
 from byol_tpu.observability.watchdog import Watchdog
@@ -125,9 +128,20 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     from byol_tpu.parallel.compile_plan import build_plan
     plan = build_plan(mesh, zero1=cfg.device.zero1 == "on")
 
+    # Flight recorder (observability/spans.py): every hot-loop phase below
+    # runs under a named span; goodput.py folds them into the wall-time
+    # partition per epoch.  --spans off hands every `with` a shared no-op
+    # (records nothing — the hot loop is byte-for-byte the unspanned one).
+    recorder = (spans_lib.SpanRecorder() if cfg.device.spans == "on"
+                else spans_lib.NULL)
+    # The meter's first window opens HERE, before the model build, so
+    # startup (build + first-step compile) is attributed, not lost.
+    goodput_meter = goodput_lib.GoodputMeter(recorder)
+
     from byol_tpu.core.rng import root_key
-    net, state, train_step, eval_step, schedule = setup_training(
-        rcfg, mesh, root_key(cfg.device.seed), plan=plan)
+    with recorder.span("startup/build"):
+        net, state, train_step, eval_step, schedule = setup_training(
+            rcfg, mesh, root_key(cfg.device.seed), plan=plan)
     if verbose:
         from byol_tpu.utils import number_of_parameters
         print(f"model: {cfg.model.arch}, "
@@ -289,6 +303,7 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
 
     timer = StepTimer(rcfg.global_batch_size, n_devices)
     flops_resolved = False
+    first_dispatch = True
     train_metrics: Dict[str, float] = {}
     test_metrics: Dict[str, float] = {}
     stopped = False
@@ -325,10 +340,27 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     # would be the host sync the whole telemetry design avoids.
     global_step = int(state.step)
 
+    def _export_trace() -> None:
+        """Write the flight-recorder ring as a Chrome-trace JSON next to
+        run.jsonl (rank 0, spans on).  Best-effort like the run log: the
+        trace is evidence, never a reason to kill the run that produced
+        it."""
+        if events is None or not recorder.enabled:
+            return
+        try:
+            spans_lib.export_chrome_trace(
+                recorder.records(),
+                os.path.join(cfg.task.log_dir, name, "trace.json"))
+        except OSError as e:
+            print(f"spans: trace export failed ({e!r}); continuing",
+                  file=sys.stderr)
+
     def _halt_dump(err: NanHaltError, epoch: int) -> None:
         """--nan-policy halt tripped: dump step/state metadata to the run
         log before the raise propagates (the post-mortem the operator
-        reads instead of a bare traceback)."""
+        reads instead of a bare traceback).  The goodput totals and the
+        flight-recorder trace land too — a halted run is exactly the one
+        whose timeline gets read."""
         if events is not None:
             events.emit("state_dump", step=err.step, epoch=epoch,
                         state_step=int(state.step),
@@ -336,6 +368,9 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                         lr=float(schedule(int(state.step))),
                         reason="nonfinite", health=err.record,
                         run_name=name)
+            if recorder.enabled:
+                goodput_meter.final(events=events, halted=True)
+                _export_trace()
             events.close()
         watchdog.stop()
         saver.close()
@@ -397,9 +432,11 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         # the meter reports this epoch's H2D payload + starvation next to
         # the throughput numbers
         input_meter = InputPipelineMeter()
+        timer.reset_ticks()
         with profiling.annotate("byol/train_dispatch"):
             for dev_batch in prefetch_to_mesh(tapped_batches(), mesh,
-                                              meter=input_meter):
+                                              meter=input_meter,
+                                              recorder=recorder):
                 if not flops_resolved:
                     # Once per fit: FLOPs of the real train step via XLA
                     # cost analysis (observability/flops.py) -> MFU next to
@@ -408,14 +445,21 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                     # input state.
                     flops_resolved = True
                     from byol_tpu.observability import flops as flops_lib
-                    with mesh:
+                    with recorder.span("startup/cost_analysis"), mesh:
                         step_flops = flops_lib.cost_analysis_flops(
                             train_step, state, dev_batch)
                     if step_flops:
                         timer.set_flops(step_flops / rcfg.global_batch_size,
                                         flops_lib.chip_peak_tflops())
-                state, metrics = train_step(state, dev_batch)
+                # The FIRST dispatch of a fit pays trace + XLA compile
+                # before the async dispatch returns: attribute it to the
+                # startup_compile bucket, not to productive step time.
+                with recorder.span("startup/compile" if first_dispatch
+                                   else "train/dispatch"):
+                    state, metrics = train_step(state, dev_batch)
+                first_dispatch = False
                 global_step += 1
+                timer.tick()
                 if sink is not None:
                     # 'health' is the packed in-graph diagnostics vector —
                     # popped so the scalar accumulator (and the epoch
@@ -424,10 +468,11 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                     # newest, drained for free after the epoch readback.
                     health_vec = metrics.pop("health")
                     try:
-                        if telemetry_mode == "step":
-                            sink.offer(global_step, health_vec)
-                        else:
-                            sink.hold(global_step, health_vec)
+                        with recorder.span("telemetry/readback"):
+                            if telemetry_mode == "step":
+                                sink.offer(global_step, health_vec)
+                            else:
+                                sink.hold(global_step, health_vec)
                     except NanHaltError as e:
                         _halt_dump(e, epoch)
                         raise
@@ -443,11 +488,18 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                         f"(--fault-at-step)")
                 if cfg.device.debug_step:  # single-minibatch smoke
                     break                  # (main.py:630)
-        with profiling.annotate("byol/epoch_readback"):
+        # the annotate region stays UNCONDITIONAL (pre-PR-9 contract: XLA
+        # captures carry the host phase markers even under --spans off);
+        # the span nests inside it when recording is on
+        with profiling.annotate("byol/epoch_readback"), \
+                recorder.span("train/epoch_readback"):
             train_metrics = {k: float(v) for k, v in acc.result().items()}
         # acc.result() is a D2H readback of sums depending on every step —
         # the only sync this platform can't fake, so the elapsed time (and
         # the throughput derived from it) is honest (StepTimer docstring).
+        # The span above is the device-catch-up window, counted as
+        # PRODUCTIVE by goodput.py: the host blocks here exactly until the
+        # queued compute drains.
         train_elapsed = time.time() - t0
         timer.record_epoch(acc.count, train_elapsed)
         watchdog.pet()  # readback returned: the collectives are alive
@@ -455,7 +507,8 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
             # epoch boundary: the readback above already synchronized, so
             # draining the pending/held vectors costs nothing extra
             try:
-                sink.drain()
+                with recorder.span("telemetry/drain"):
+                    sink.drain()
             except NanHaltError as e:
                 _halt_dump(e, epoch)
                 raise
@@ -470,17 +523,22 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
             print(input_log_line(epoch, input_meter))
 
         if events is not None:
+            # step-time p50/p99 (dispatch intervals; see StepTimer.tick):
+            # optional additive fields — absent when the epoch had too few
+            # steps for a tail (e.g. debug_step)
             events.emit("epoch", epoch=epoch, split="train",
                         step=global_step, metrics=train_metrics,
                         seconds=round(train_elapsed, 3),
                         input_pipeline=input_meter.result(),
                         images_per_sec_per_chip=(
-                            timer.images_per_sec_per_chip()))
+                            timer.images_per_sec_per_chip()),
+                        **(timer.epoch_step_quantiles() or {}))
 
         # ---- eval (prefix='test', main.py:680-692) -----------------------
         t0 = time.time()
-        acc = run_eval(state)
-        test_metrics = {k: float(v) for k, v in acc.result().items()}
+        with recorder.span("eval/run", split="test"):
+            acc = run_eval(state)
+            test_metrics = {k: float(v) for k, v in acc.result().items()}
         watchdog.pet()  # eval readback returned
         _maybe_preempt_save()
         if verbose:
@@ -500,8 +558,10 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         # (reference parity, main.py:752,766) -------------------------------
         if loader.make_valid_iter is not None:
             t0 = time.time()
-            vacc = run_eval(state, loader.valid_loader)
-            valid_metrics = {k: float(v) for k, v in vacc.result().items()}
+            with recorder.span("eval/run", split="valid"):
+                vacc = run_eval(state, loader.valid_loader)
+                valid_metrics = {k: float(v)
+                                 for k, v in vacc.result().items()}
             if verbose:
                 n_va = vacc.total_weight()
                 print(epoch_log_line(
@@ -546,7 +606,8 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
         # The save serializes device state (a D2H readback window on pods):
         # pet around it so a wedged collective during the flush is caught.
         watchdog.pet()
-        with profiling.annotate("byol/checkpoint"):
+        with profiling.annotate("byol/checkpoint"), \
+                recorder.span("checkpoint/save", epoch=epoch):
             stop_now = saver(test_metrics.get("loss_mean", float("inf")),
                              epoch, _save_state(state))
         watchdog.pet()
@@ -555,10 +616,23 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
                         metric=test_metrics.get("loss_mean"),
                         best_metric=saver.best_metric,
                         early_stop=bool(stop_now))
+        # ---- goodput fold: close this epoch's wall-time window ------------
+        # (train + eval + valid + grapher + checkpoint), attribute its
+        # spans, and emit the goodput + span_stats events.  Every second
+        # since the previous fold lands in exactly one bucket.  Spans off:
+        # no fold — an empty ring would "attribute" the whole epoch to
+        # host_other, a claim the run never measured.
+        if recorder.enabled:
+            goodput_meter.fold(scope="epoch", epoch=epoch, mfu=timer.mfu(),
+                               events=events,
+                               images_per_sec_per_chip=(
+                                   timer.images_per_sec_per_chip()))
         if stop_now:
             state, _ = _restore(state, best=True)
-            acc = run_eval(state)
-            test_metrics = {k: float(v) for k, v in acc.result().items()}
+            with recorder.span("eval/run", split="test_best"):
+                acc = run_eval(state)
+                test_metrics = {k: float(v)
+                                for k, v in acc.result().items()}
             stopped = True
             if verbose:
                 print(f"early stop at epoch {epoch}; restored best "
@@ -568,6 +642,11 @@ def fit(cfg: Config, *, loader: Optional[LoaderBundle] = None,
     watchdog.stop()
     if old_sigterm is not None:
         signal.signal(signal.SIGTERM, old_sigterm)
+    # run-scope goodput totals (the end-of-run waterfall `python -m
+    # byol_tpu report` renders) + the Chrome-trace flight-recorder dump
+    if recorder.enabled:
+        goodput_meter.final(events=events, mfu=timer.mfu())
+        _export_trace()
     if events is not None:
         events.emit(
             "run_end", epoch=epoch, stopped_early=stopped,

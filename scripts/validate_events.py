@@ -8,11 +8,19 @@ file through the STRICT reader (``read_events``: per-line JSON parse +
 per-kind required-field validation) so a writer drifting from the schema
 fails the build instead of silently producing logs no tool can parse.
 
-Usage: ``python scripts/validate_events.py FILE [FILE ...]``
+Usage: ``python scripts/validate_events.py [--require k1,k2] FILE ...``
 Exits non-zero on the first invalid file, naming the line.  A missing
 file is an error (CI passes exactly the files the preceding steps
 produced); an empty file is an error too — a step that claims to emit
 events and emits none is itself drift.
+
+``--require goodput,span_stats`` additionally demands that EVERY given
+file carry at least one event of each named kind — the ISSUE 9 CI gate:
+a smoke fit whose run.jsonl lacks the goodput partition means the span
+-> goodput pipeline silently detached from the trainer.  (The kinds
+themselves — including the goodput partition identity, badput buckets
+summing to wall time within 1% — are validated per line by the schema
+module; this flag only asserts presence.)
 
 Pure-stdlib + numpy import chain (events.py), no jax: safe to run before
 or after any backend-touching step.
@@ -39,7 +47,7 @@ def _load_events_module():
     return mod
 
 
-def validate(path: str) -> str:
+def validate(path: str, require=()) -> str:
     events_mod = _load_events_module()
     kinds = collections.Counter()
     for event in events_mod.read_events(path):
@@ -47,16 +55,30 @@ def validate(path: str) -> str:
     if not kinds:
         raise ValueError(f"{path}: no events — the emitting step wrote an "
                          "empty log")
+    missing = [k for k in require if not kinds.get(k)]
+    if missing:
+        raise ValueError(
+            f"{path}: required event kind(s) {missing} absent "
+            f"(present: {sorted(kinds)}) — the emitter detached from the "
+            "schema it claims")
     return ", ".join(f"{k}={n}" for k, n in sorted(kinds.items()))
 
 
 def main(argv) -> int:
+    argv = list(argv)
+    require = ()
+    if argv and argv[0] == "--require":
+        if len(argv) < 2:
+            print(__doc__, file=sys.stderr)
+            return 2
+        require = tuple(k for k in argv[1].split(",") if k)
+        argv = argv[2:]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
     for path in argv:
         try:
-            summary = validate(path)
+            summary = validate(path, require=require)
         except (OSError, ValueError) as e:
             print(f"validate_events: FAIL {e}", file=sys.stderr)
             return 1

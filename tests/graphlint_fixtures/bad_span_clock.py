@@ -1,0 +1,35 @@
+"""GL101 fixture: host clocks / span recording inside traced code (fires).
+
+Everything here runs ONCE at trace time and is constant-folded into the
+executable — the "timings" are frozen compile-time values that measure
+nothing per step (the exact failure mode the spans-module docstring and
+the ISSUE 9 satellite name)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+from byol_tpu.observability import spans
+
+
+@jax.jit
+def timed_step(x):
+    t0 = time.perf_counter()          # constant-folded: trace-time clock
+    y = jnp.sum(x * x)
+    elapsed = time.perf_counter() - t0   # always ~the trace duration
+    return y, elapsed
+
+
+@jax.jit
+def spanned_step(x):
+    with spans.span("train/dispatch"):   # opens/closes once, at trace time
+        return jnp.dot(x, x)
+
+
+def scan_body(carry, x):
+    wall = time.time()                # same bug under lax.scan's trace
+    return carry + x, wall
+
+
+def run(carry, xs):
+    return jax.lax.scan(scan_body, carry, xs)

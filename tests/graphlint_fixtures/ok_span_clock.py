@@ -1,0 +1,28 @@
+"""GL101 near-miss: clocks and spans at the HOST call sites (clean).
+
+Timing the dispatch loop — outside any traced scope — is exactly what
+observability/spans.py is for; the rule must not fire on the legitimate
+pattern the trainer uses."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+from byol_tpu.observability import spans
+
+
+@jax.jit
+def step(x):
+    return jnp.dot(x, x)
+
+
+def timed_epoch(batches):
+    """Host-side span + clock around the traced call: legitimate."""
+    t0 = time.perf_counter()
+    out = None
+    for b in batches:
+        with spans.span("train/dispatch"):
+            out = step(b)
+    with spans.span("train/epoch_readback"):
+        total = float(jnp.sum(out))
+    return total, time.perf_counter() - t0

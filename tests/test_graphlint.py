@@ -33,6 +33,9 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 # (rule id, must-fire fixture, must-stay-clean fixture)
 RULE_CASES = [
     ("GL101", "bad_host_sync.py", "ok_host_sync.py"),
+    # host clocks / span recording under a trace get constant-folded —
+    # the flight-recorder (ISSUE 9) shape of the same rule
+    ("GL101", "bad_span_clock.py", "ok_span_clock.py"),
     ("GL102", "bad_recompile.py", "ok_recompile.py"),
     ("GL103", "bad_prng.py", "ok_prng.py"),
     ("GL104", "bad_donate.py", "ok_donate.py"),

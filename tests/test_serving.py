@@ -183,6 +183,80 @@ class TestServingMeter:
         # the log line renders NaN windows without crashing
         assert "serve[" in serve_log_line(empty)
 
+    def test_quantiles_at_small_sample_counts(self):
+        """p50 <= p99 must hold from the FIRST sample on — tail math over
+        one or two latencies (a cold service's first stats window) must
+        interpolate, never crash or invert (ISSUE 9 satellite)."""
+        m = ServingMeter()
+        m.record_latency(0.010)
+        one = m.snapshot(1.0, reset=False)
+        assert one["p50_ms"] == pytest.approx(10.0)
+        assert one["p99_ms"] == pytest.approx(10.0)      # 1 sample: p50==p99
+        m.record_latency(0.030)
+        two = m.snapshot(2.0, reset=True)
+        assert two["requests"] == 2
+        assert two["p50_ms"] <= two["p99_ms"] <= 30.0 + 1e-9
+        m.record_latency(0.005)
+        m.record_latency(0.007)
+        m.record_latency(0.009)
+        three = m.snapshot(3.0, reset=True)
+        assert three["p50_ms"] == pytest.approx(7.0)
+        assert three["p50_ms"] <= three["p99_ms"]
+
+    def test_snapshot_under_load_never_drops_or_inverts(self):
+        """Concurrent record_latency vs snapshot(reset=True): every sample
+        lands in exactly ONE window (nothing lost to a reset race) and
+        every window's percentiles stay ordered (ISSUE 9 satellite)."""
+        m = ServingMeter()
+        n_threads, per_thread = 4, 500
+        stop = threading.Event()
+        windows = []
+
+        def producer(idx):
+            rng = np.random.RandomState(idx)
+            for _ in range(per_thread):
+                m.record_latency(float(rng.uniform(0.001, 0.050)))
+
+        def reader():
+            while not stop.is_set():
+                windows.append(m.snapshot(time.perf_counter(), reset=True))
+
+        threads = [threading.Thread(target=producer, args=(i,))
+                   for i in range(n_threads)]
+        snap_thread = threading.Thread(target=reader)
+        snap_thread.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        snap_thread.join()
+        windows.append(m.snapshot(time.perf_counter(), reset=True))
+        counted = sum(int(w["requests"]) for w in windows)
+        assert counted == n_threads * per_thread     # reset drops nothing
+        assert m.total_requests == n_threads * per_thread
+        for w in windows:
+            if w["requests"]:
+                assert w["p50_ms"] <= w["p99_ms"] + 1e-9
+
+    def test_lifecycle_phase_breakdown(self):
+        """record_lifecycle folds per-request phase deltas into window
+        means; snapshot exposes them as the additive ``phase_ms`` field
+        and reset clears them."""
+        m = ServingMeter()
+        m.record_latency(0.010)
+        m.record_lifecycle({"coalesce": 0.004, "stage": 0.001,
+                            "dispatch": 0.003, "readback": 0.001,
+                            "deliver": 0.001})
+        m.record_lifecycle({"coalesce": 0.002, "stage": 0.001,
+                            "dispatch": 0.001, "readback": 0.001,
+                            "deliver": 0.001})
+        snap = m.snapshot(1.0, reset=True)
+        assert snap["phase_ms"]["coalesce"] == pytest.approx(3.0)
+        assert snap["phase_ms"]["dispatch"] == pytest.approx(2.0)
+        empty = m.snapshot(2.0, reset=False)
+        assert "phase_ms" not in empty               # window reset cleared
+
     def test_serve_stats_event_roundtrip(self, tmp_path):
         from byol_tpu.observability.events import RunLog, read_events
         m = ServingMeter()
@@ -307,7 +381,13 @@ class TestServingCorrectness:
 
     def test_full_service_roundtrip_matches_too(self, served):
         """Same pin through the THREADED path: queue -> coalesce ->
-        worker -> futures (the engine test above bypasses the batcher)."""
+        worker -> futures (the engine test above bypasses the batcher) —
+        and every request that came back carries its COMPLETE lifecycle
+        (enqueue -> coalesce -> stage -> dispatch -> readback -> deliver,
+        monotonic, with a unique trace id): the ISSUE 9 acceptance pin
+        that serving spans cover the full request path under the same
+        scenario as the bitwise-parity check."""
+        from byol_tpu.serving.batcher import LIFECYCLE_PHASES
         rng = np.random.RandomState(8)
         images = rng.rand(6, 16, 16, 3).astype(np.float32)
         expected = _extractor_features(served, images)
@@ -317,6 +397,14 @@ class TestServingCorrectness:
         reqs = [svc.submit(images[i]) for i in range(6)]
         got = np.stack([r.result(timeout=120.0)[0] for r in reqs])
         np.testing.assert_array_equal(got, expected)
+        assert len({r.trace_id for r in reqs}) == len(reqs)
+        for r in reqs:
+            stamps = [r.marks[p] for p in LIFECYCLE_PHASES]
+            assert len(stamps) == len(LIFECYCLE_PHASES)   # all phases hit
+            assert stamps == sorted(stamps)               # causal order
+            # the phase deltas reconstruct the meter's latency sample
+            assert sum(r.lifecycle().values()) == pytest.approx(
+                r.marks["deliver"] - r.marks["enqueue"])
 
     def test_restored_onto_fewer_devices(self, served):
         """The checkpoint trained on 8 devices; the serving mesh has 4 —
@@ -399,9 +487,12 @@ class _StubEngine:
         self.compile_count = len(self.buckets.sizes)
         self.fail_rows = set(fail_rows)
 
-    def embed(self, rows):
+    def embed(self, rows, timeline=None):
         if rows.shape[0] in self.fail_rows:
             raise RuntimeError(f"boom at {rows.shape[0]} rows")
+        if timeline is not None:
+            t = time.perf_counter()
+            timeline.update(stage=t, dispatch=t, readback=t)
         return rows.reshape(rows.shape[0], -1)[:, :4].astype(np.float32)
 
 
@@ -502,6 +593,52 @@ class TestServicePolicy:
         out = served.service.engine.embed(
             rng.rand(3, 16, 16, 3).astype(np.float32))   # bucket 8, n=3
         assert out.base is None
+
+    def test_lifecycle_spans_and_trace_ids_through_worker(self):
+        """The per-request flight path through the REAL worker loop (stub
+        engine): coalesced requests share batch-level stage/dispatch/
+        readback stamps, each keeps its own enqueue, the worker's
+        serve/batch span carries the members' trace ids, and phase means
+        reach the serve_stats snapshot."""
+        from byol_tpu.observability import spans as spans_lib
+        from byol_tpu.serving.batcher import LIFECYCLE_PHASES
+        rec = spans_lib.SpanRecorder()
+        svc = EmbeddingService(
+            _StubEngine(), DynamicBatcher(max_batch=16, max_wait_s=0.01),
+            recorder=rec)
+        svc.start(warmup=False)
+        reqs = [svc.submit(_img()) for _ in range(3)]
+        for r in reqs:
+            r.result(timeout=10.0)
+        svc.stop()
+        for r in reqs:
+            assert set(LIFECYCLE_PHASES) <= set(r.marks)
+            stamps = [r.marks[p] for p in LIFECYCLE_PHASES]
+            assert stamps == sorted(stamps)
+        batch_spans = [s for s in rec.records() if s.name == "serve/batch"]
+        assert batch_spans
+        spanned_ids = {tid for s in batch_spans
+                       for tid in s.attrs["trace_ids"]}
+        assert {r.trace_id for r in reqs} <= spanned_ids
+        # lifetime totals prove the breakdown was fed once per request
+        assert svc.meter.total_requests == 3
+
+    def test_failed_request_keeps_partial_lifecycle(self):
+        """An engine failure resolves the future with the error; the
+        request still carries the phases it reached (enqueue/coalesce) —
+        the post-mortem breadcrumb — and never a deliver stamp."""
+        svc = EmbeddingService(
+            _StubEngine(fail_rows=(2,)),
+            DynamicBatcher(max_batch=16, max_wait_s=0.01))
+        svc.start(warmup=False)
+        bad = [svc.submit(_img()) for _ in range(2)]
+        for r in bad:
+            with pytest.raises(RuntimeError, match="boom"):
+                r.result(timeout=10.0)
+        for r in bad:
+            assert "enqueue" in r.marks and "coalesce" in r.marks
+            assert "deliver" not in r.marks
+        svc.stop()
 
     def test_concurrent_streams_all_answered(self):
         svc = EmbeddingService(

@@ -627,6 +627,16 @@ class TestFitRunLog:
         train_ep = next(e for e in epochs if e["split"] == "train")
         assert "input_pipeline" in train_ep
         assert "loss_mean" in train_ep["metrics"]
+        # flight recorder (default --spans on): per-epoch + run-scope
+        # goodput partitions (identity validated by the reader) and the
+        # Chrome trace next to the log (ISSUE 9)
+        goodputs = [e for e in got if e["kind"] == "goodput"]
+        assert {e["scope"] for e in goodputs} >= {"epoch", "run"}
+        import os
+        trace = os.path.join(os.path.dirname(self._run_log(cfg)),
+                             "trace.json")
+        with open(trace) as f:
+            assert json.load(f)["traceEvents"]
 
     def test_fit_survives_unopenable_run_log(self, tmp_path):
         """RunLog's best_effort only guards WRITES; the constructor's

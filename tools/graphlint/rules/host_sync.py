@@ -16,7 +16,13 @@ Flagged inside traced scopes:
   ``.copy_to_host_async()`` on non-static receivers;
 - ``float()`` / ``int()`` / ``bool()`` on values *provably* arrays (derived
   from jnp/jax calls or array-annotated parameters).  Unknown scalars are
-  deliberately not flagged — hyperparameter plumbing would drown the signal.
+  deliberately not flagged — hyperparameter plumbing would drown the signal;
+- host clocks (``time.time`` / ``time.perf_counter`` / ``time.monotonic``
+  and their ``_ns`` variants) and flight-recorder span entry points
+  (``observability.spans.span``): under a trace these run ONCE at trace
+  time and are constant-folded into the executable — the "timing" they
+  produce is a frozen compile-time value that measures nothing per step.
+  Time at the DISPATCH site instead (observability/spans.py module doc).
 """
 from __future__ import annotations
 
@@ -31,6 +37,20 @@ from tools.graphlint.engine import Context, Finding, LintedFile, Rule
 _SYNC_METHODS = {"item", "tolist", "block_until_ready",
                  "copy_to_host_async", "__array__"}
 _CAST_BUILTINS = {"float", "int", "bool", "complex"}
+# Host clocks: reading one under a trace bakes the TRACE-TIME value into
+# the executable (a constant, not a measurement).
+_HOST_CLOCKS = {"time.time", "time.time_ns", "time.perf_counter",
+                "time.perf_counter_ns", "time.monotonic",
+                "time.monotonic_ns", "time.process_time",
+                "time.process_time_ns"}
+# Flight-recorder entry points (observability/spans.py): a span context
+# manager under a trace opens/closes once at trace time — it records a
+# meaningless near-zero span and nothing per step.  Matched by resolved-
+# qualname suffix so every import spelling of the module is covered
+# (absolute, relative, aliased); bare method calls on local recorder
+# objects are deliberately NOT matched (unresolvable receiver — flagging
+# every ``.span(`` attribute would drown the signal in false positives).
+_SPAN_SUFFIXES = ("spans.span",)
 
 
 class HostSyncRule(Rule):
@@ -54,6 +74,22 @@ class HostSyncRule(Rule):
                     findings.append(self.finding(
                         f, node, "jax.device_get inside traced code forces "
                         "a device->host transfer per step"))
+                    continue
+                if q in _HOST_CLOCKS:
+                    findings.append(self.finding(
+                        f, node, f"host clock '{q}' inside traced code is "
+                        "read once at trace time and constant-folded — it "
+                        "measures nothing per step; time the dispatch call "
+                        "site instead (observability/spans.py)"))
+                    continue
+                if q and (q in _SPAN_SUFFIXES
+                          or any(q.endswith("." + s)
+                                 for s in _SPAN_SUFFIXES)):
+                    findings.append(self.finding(
+                        f, node, "span recording inside traced code opens/"
+                        "closes once at trace time (a frozen, near-zero "
+                        "span) — wrap the host-side dispatch call instead "
+                        "(observability/spans.py module doc)"))
                     continue
                 if q and (q.startswith("numpy.") or q == "numpy"):
                     args = list(node.args) + [k.value for k in node.keywords]
