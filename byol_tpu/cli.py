@@ -192,6 +192,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(parallel/compile_plan.py)")
     x.add_argument("--fsdp", action="store_true",
                    help=argparse.SUPPRESS)  # deprecated alias: --zero1 on
+    x.add_argument("--fused-update", type=str, default="off",
+                   choices=("off", "on"),
+                   help="fused LARS+EMA weight update (ops/fused_update.py "
+                        "Pallas kernel): 'on' computes per-layer trust "
+                        "ratios from a flat segment-norm pass and applies "
+                        "weight decay + trust scaling + momentum tick + "
+                        "param write + EMA target tick in ONE pass over "
+                        "the flat parameter buffer (~3 elementwise HBM "
+                        "sweeps -> ~1; shard-local under --zero1 on).  "
+                        "Requires --optimizer lars_momentum with --clip 0; "
+                        "'off' lowers the exact unfused graph")
     x.add_argument("--fuse-views", action="store_true",
                    help="one fused encoder call for both views (perf; "
                         "changes BN batch statistics vs the reference)")
@@ -333,7 +344,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             warmup=args.warmup, optimizer=args.optimizer,
             early_stop=args.early_stop,
             accum_steps=args.accum_steps,
-            accum_bn_mode=args.accum_bn_mode),
+            accum_bn_mode=args.accum_bn_mode,
+            fused_update=args.fused_update),
         device=DeviceConfig(
             num_replicas=n_rep,
             workers_per_replica=args.workers_per_replica,

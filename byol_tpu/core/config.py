@@ -154,6 +154,14 @@ class OptimConfig:
     #                big-batch memory back (all microbatches in flight):
     #                a semantics oracle for parity tests, not an HBM saver.
     accum_bn_mode: str = "average"
+    # Fused LARS+EMA weight update (ops/fused_update.py): 'on' replaces the
+    # optax chain + EMA tick — ~3 full-parameter elementwise HBM sweeps per
+    # optimizer step — with one Pallas kernel pass over a flat segmented
+    # buffer (segment norms -> trust ratios -> wd/momentum/param/EMA in one
+    # read-modify-write), shard-local under --zero1 on.  Requires the
+    # lars_momentum chain with --clip 0 (validated at resolve()); 'off'
+    # lowers the exact unfused graph (HLO identity pinned by test).
+    fused_update: str = "off"
 
 
 @_frozen
@@ -361,6 +369,28 @@ def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
             "--zero1 on does not compose with --model-parallel > 1 "
             "(tensor parallelism already shards those optimizer-state "
             "leaves over the 'model' axis)")
+    if cfg.optim.fused_update not in ("off", "on"):
+        raise ValueError(
+            f"unknown fused_update mode {cfg.optim.fused_update!r}; "
+            "'off' | 'on'")
+    if cfg.optim.fused_update == "on":
+        # the kernel implements exactly the lars_momentum chain; any other
+        # optimizer config would silently train with different math
+        from byol_tpu.optim.factory import fused_update_unsupported_reason
+        reason = fused_update_unsupported_reason(cfg.optim.optimizer,
+                                                 cfg.optim.clip)
+        if reason is not None:
+            raise ValueError(f"--fused-update on: {reason}")
+        if cfg.device.model_parallel > 1:
+            # the replicated-layout kernel runs under a shard_map with
+            # fully-replicated specs — it would silently all-gather the
+            # TP-sharded head params/opt-state leaves every step (the
+            # same non-composition --zero1 on rejects above)
+            raise ValueError(
+                "--fused-update on does not compose with "
+                "--model-parallel > 1 (tensor parallelism shards head "
+                "opt-state leaves over 'model'; the fused kernel's flat "
+                "buffer would un-shard them every step)")
     if cfg.device.nan_policy == "halt" and cfg.device.telemetry == "off":
         # the sink that enforces halt only exists when telemetry is on —
         # accepting this combination would silently train through NaNs,

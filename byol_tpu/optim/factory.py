@@ -25,6 +25,11 @@ import optax
 from byol_tpu.optim import lars as lars_lib
 from byol_tpu.optim import schedules as sched_lib
 
+# the 'momentum' registry entry's decay (reference main.py:311) — also the
+# momentum the fused update kernel ticks (training/steps.py), so the
+# number has exactly one home
+MOMENTUM_DECAY = 0.9
+
 
 def _base_optimizer(name: str, learning_rate) -> optax.GradientTransformation:
     if name == "rmsprop":
@@ -37,7 +42,7 @@ def _base_optimizer(name: str, learning_rate) -> optax.GradientTransformation:
     if name == "sgd":
         return optax.sgd(learning_rate)
     if name == "momentum":
-        return optax.sgd(learning_rate, momentum=0.9)
+        return optax.sgd(learning_rate, momentum=MOMENTUM_DECAY)
     if name == "lamb":
         return optax.lamb(learning_rate)
     if name == "lbfgs":
@@ -58,6 +63,77 @@ def is_lars_optimizer(opt_name: str) -> bool:
     return opt_name.lower().strip().startswith("lars_")
 
 
+def fused_update_unsupported_reason(opt_name: str,
+                                    clip: float = 0.0) -> Optional[str]:
+    """Why ``--fused-update on`` cannot serve this optimizer config —
+    ``None`` when the fused Pallas kernel (ops/fused_update.py) computes
+    exactly the chain :func:`build_optimizer` would.  The ONE gating
+    predicate, shared by config resolve() (fail fast at the CLI) and the
+    step builder (fail fast for programmatic callers)."""
+    full = opt_name.lower().strip()
+    if not is_lars_optimizer(full):
+        return (f"optimizer {opt_name!r} does not build the LARS wrapper "
+                "chain; the fused kernel implements wd fold-in + trust "
+                "ratio + momentum (use lars_momentum)")
+    if full.split("_")[-1] != "momentum":
+        return (f"inner optimizer {full.split('_')[-1]!r} is not the sgd-"
+                "momentum trace the fused kernel ticks (use lars_momentum)")
+    if clip > 0.0:
+        return ("--clip > 0 value-clips gradients before LARS; the fused "
+                "kernel does not replicate the clip")
+    return None
+
+
+def extract_sgdm_state(opt_state: Any) -> Tuple[Any, Any]:
+    """``(momentum_trace_tree, schedule_count)`` out of the lars_momentum
+    chain state — located by node TYPE (TraceState / ScaleByScheduleState),
+    not by tuple position, so an optax version reshuffling the chain
+    nesting fails loudly here instead of silently reading the wrong slot.
+    The fused update reads these, ticks them in-kernel, and writes them
+    back via :func:`replace_sgdm_state`; the opt_state PYTREE STRUCTURE is
+    never changed (checkpoints, shardings, and the zero1 codec all key on
+    it)."""
+    traces, counts = [], []
+
+    def walk(node):
+        if isinstance(node, optax.TraceState):
+            traces.append(node.trace)
+        elif isinstance(node, optax.ScaleByScheduleState):
+            counts.append(node.count)
+        elif isinstance(node, tuple):
+            for child in node:
+                walk(child)
+
+    walk(opt_state)
+    if len(traces) != 1 or len(counts) != 1:
+        raise ValueError(
+            f"opt_state is not the lars_momentum chain the fused update "
+            f"expects: found {len(traces)} TraceState / {len(counts)} "
+            "ScaleByScheduleState nodes (fused_update_unsupported_reason "
+            "should have rejected this config)")
+    return traces[0], counts[0]
+
+
+def replace_sgdm_state(opt_state: Any, new_trace: Any,
+                       new_count: Any) -> Any:
+    """Rebuild the chain state with a fresh momentum trace + schedule
+    count — the exact inverse of :func:`extract_sgdm_state` (every other
+    node, including the empty wd/LARS states, passes through untouched)."""
+
+    def rebuild(node):
+        if isinstance(node, optax.TraceState):
+            return optax.TraceState(trace=new_trace)
+        if isinstance(node, optax.ScaleByScheduleState):
+            return optax.ScaleByScheduleState(count=new_count)
+        if isinstance(node, tuple) and hasattr(node, "_fields"):
+            return type(node)(*[rebuild(c) for c in node])
+        if isinstance(node, tuple):
+            return tuple(rebuild(c) for c in node)
+        return node
+
+    return rebuild(opt_state)
+
+
 def build_optimizer(opt_name: str, *,
                     base_lr: float,
                     global_batch_size: int,
@@ -67,8 +143,8 @@ def build_optimizer(opt_name: str, *,
                     lr_schedule_kind: str = "cosine",
                     steps_per_epoch: Optional[int] = None,
                     clip: float = 0.0,
-                    trust_coefficient: float = 1e-3,
-                    lars_eps: float = 0.0,
+                    trust_coefficient: float = lars_lib.TRUST_COEFFICIENT_DEFAULT,
+                    lars_eps: float = lars_lib.LARS_EPS_DEFAULT,
                     adapt_mask: Optional[Any] = None,
                     ) -> Tuple[optax.GradientTransformation, optax.Schedule]:
     """Build the full gradient transformation + the lr schedule (returned

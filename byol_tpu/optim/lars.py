@@ -28,6 +28,13 @@ import optax
 
 MaskOrFn = Union[Any, Callable[[Any], Any]]
 
+# Factory defaults (reference main.py:339-340) — the ONE home for these
+# numbers: optim/factory.py's signature and the fused update kernel
+# (ops/fused_update.py) both read them here, so the fused path can never
+# apply a ratio computed with drifted hyperparameters.
+TRUST_COEFFICIENT_DEFAULT = 1e-3
+LARS_EPS_DEFAULT = 0.0
+
 
 def default_exclusion_mask(params) -> Any:
     """True where LARS adaptation / weight decay applies.
@@ -50,6 +57,24 @@ class LarsState(NamedTuple):
     pass
 
 
+def trust_ratio_from_norms(param_norm: jnp.ndarray, grad_norm: jnp.ndarray,
+                           trust_coefficient: float = TRUST_COEFFICIENT_DEFAULT,
+                           eps: float = LARS_EPS_DEFAULT) -> jnp.ndarray:
+    """Steps 2-3 on PRECOMPUTED norms (lars.py:100-108), elementwise.
+
+    The ONE trust-ratio formula: :func:`_leaf_trust_ratio` (the optax
+    transform + per-leaf telemetry) applies it to scalar norms, and the
+    fused Pallas kernel (ops/fused_update.py) applies it to its
+    segment-norm vectors — so a norm source can change without the ratio
+    semantics ever forking.  ``grad_norm`` must be of the POST-weight-decay
+    gradient (step 1 folds wd in first).
+    """
+    return jnp.where(
+        (param_norm > 0.0) & (grad_norm > 0.0),
+        trust_coefficient * param_norm / (grad_norm + eps),
+        jnp.ones((), jnp.float32))
+
+
 def _leaf_trust_ratio(g: jnp.ndarray, p: jnp.ndarray,
                       trust_coefficient: float, eps: float) -> jnp.ndarray:
     """The per-layer-group LARS trust ratio (lars.py:100-108), fp32 scalar.
@@ -60,17 +85,14 @@ def _leaf_trust_ratio(g: jnp.ndarray, p: jnp.ndarray,
     """
     g32 = g.astype(jnp.float32)
     p32 = p.astype(jnp.float32)
-    param_norm = jnp.linalg.norm(p32)
-    grad_norm = jnp.linalg.norm(g32)
-    return jnp.where(
-        (param_norm > 0.0) & (grad_norm > 0.0),
-        trust_coefficient * param_norm / (grad_norm + eps),
-        1.0)
+    return trust_ratio_from_norms(jnp.linalg.norm(p32),
+                                  jnp.linalg.norm(g32),
+                                  trust_coefficient, eps)
 
 
 def trust_ratio_vector(updates: Any, params: Any,
-                       trust_coefficient: float = 1e-3,
-                       eps: float = 0.0,
+                       trust_coefficient: float = TRUST_COEFFICIENT_DEFAULT,
+                       eps: float = LARS_EPS_DEFAULT,
                        mask: Optional[MaskOrFn] = None) -> jnp.ndarray:
     """Per-layer-group trust ratios as one stacked fp32 vector.
 
@@ -96,8 +118,8 @@ def trust_ratio_vector(updates: Any, params: Any,
     return jnp.stack(ratios)
 
 
-def scale_by_lars_trust_ratio(trust_coefficient: float = 1e-3,
-                              eps: float = 0.0,
+def scale_by_lars_trust_ratio(trust_coefficient: float = TRUST_COEFFICIENT_DEFAULT,
+                              eps: float = LARS_EPS_DEFAULT,
                               mask: Optional[MaskOrFn] = None
                               ) -> optax.GradientTransformation:
     """Step 2-3 above: multiply masked gradients by the trust ratio."""
@@ -138,8 +160,8 @@ def lars_weight_decay(weight_decay: float,
 
 def lars(inner: optax.GradientTransformation,
          weight_decay: float = 0.0,
-         trust_coefficient: float = 1e-3,
-         eps: float = 0.0,
+         trust_coefficient: float = TRUST_COEFFICIENT_DEFAULT,
+         eps: float = LARS_EPS_DEFAULT,
          mask: Optional[MaskOrFn] = None) -> optax.GradientTransformation:
     """Compose wd fold-in + trust ratio + inner optimizer — the analog of
     ``LARS(optimizer=...)`` wrapping at reference main.py:339-340."""

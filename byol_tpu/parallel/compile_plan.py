@@ -25,6 +25,13 @@ answer is declared data in ONE place:
   in_shardings=...)`` outside this module, or a PartitionSpec naming an
   axis the parallel/ modules never declared, is a lint failure.
 
+The fused weight-update kernel (``--fused-update on``,
+ops/fused_update.py) consumes this plan's layouts unchanged: same state
+shardings, same donation, same ``Zero1Context`` — it swaps WHAT computes
+the update (one Pallas pass instead of the optax chain), never where
+anything lives, which is why ``--fused-update off`` lowers byte-identical
+HLO (tests/test_fused_update.py).
+
 ``--zero1 off`` must lower the exact pre-plan graph: the plan then passes
 the same partitioning.py shardings and the same donation the per-site jit
 calls passed, pinned by an HLO-identity test (tests/test_zero1.py).

@@ -129,6 +129,8 @@ def step_config(rcfg: ResolvedConfig) -> StepConfig:
         accum_steps=cfg.optim.accum_steps,
         accum_bn_mode=cfg.optim.accum_bn_mode,
         normalize_inputs=cfg.parity.normalize_inputs,
+        clip=cfg.optim.clip,
+        fused_update=cfg.optim.fused_update == "on",
         augment_in_step=cfg.task.augment_placement == "step",
         image_size=rcfg.input_shape[0],
         color_jitter_strength=cfg.regularizer.color_jitter_strength,
@@ -225,8 +227,12 @@ def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array,
     state, state_sh = plan.prepare_state(state, tx)
     z1 = plan.zero1_context()
 
+    # lr_schedule + mesh feed ONLY the fused-update path (the kernel needs
+    # the bare lr value and a mesh for its shard_map); with fused_update
+    # off they are inert and the traced graph is unchanged.
     train_step = plan.jit_train_step(
-        make_train_step(net, tx, scfg, policy, zero1_ctx=z1), state_sh)
+        make_train_step(net, tx, scfg, policy, zero1_ctx=z1,
+                        lr_schedule=schedule, mesh=mesh), state_sh)
     eval_step = plan.jit_eval_step(
         make_eval_step(net, scfg, policy, zero1_ctx=z1), state_sh)
 

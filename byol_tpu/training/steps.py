@@ -134,12 +134,35 @@ class StepConfig:
                                          # metrics['health'].  'off' traces
                                          # the exact pre-telemetry graph
                                          # (pinned by an HLO-identity test).
-    weight_decay: float = 0.0            # telemetry only: LARS folds wd
-                                         # into the gradient BEFORE the
-                                         # trust ratio (optim/lars.py step
-                                         # 1), so the health vector's trust
-                                         # stats must see g + wd*p too or
-                                         # they drift from what was applied
+    weight_decay: float = 0.0            # telemetry + fused update: LARS
+                                         # folds wd into the gradient
+                                         # BEFORE the trust ratio
+                                         # (optim/lars.py step 1), so the
+                                         # health vector's trust stats
+                                         # must see g + wd*p too or they
+                                         # drift from what was applied;
+                                         # the fused kernel folds the same
+                                         # wd in its norm + apply passes
+    clip: float = 0.0                    # fused-update gating only: the
+                                         # --clip value the optimizer
+                                         # chain was built with.  The
+                                         # fused kernel does not replicate
+                                         # value clipping, so clip > 0
+                                         # with fused_update=True is
+                                         # rejected at build — config
+                                         # resolve() catches the CLI, this
+                                         # field catches programmatic
+                                         # callers handing a clip-bearing
+                                         # tx to make_train_step
+    fused_update: bool = False           # --fused-update on: replace the
+                                         # optax chain + EMA tick with the
+                                         # fused Pallas kernel
+                                         # (ops/fused_update.py) — one pass
+                                         # over the flat parameter buffer,
+                                         # shard-local under ZeRO-1.  False
+                                         # traces the exact unfused graph
+                                         # (HLO identity pinned by
+                                         # tests/test_fused_update.py)
     lars_in_chain: bool = True           # telemetry only: the optimizer
                                          # chain contains the LARS wrapper
                                          # (build.py: 'lars_' prefix).
@@ -215,7 +238,8 @@ def augment_keys(seed: int, step, k: int) -> jnp.ndarray:
 
 
 def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
-                    policy: Policy = FP32, zero1_ctx=None
+                    policy: Policy = FP32, zero1_ctx=None,
+                    lr_schedule=None, mesh=None
                     ) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
                                   Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build the jittable train step: (state, batch) -> (state, metrics).
@@ -235,6 +259,20 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
     is elementwise, arXiv 2307.13813 — it never needs the full tree).
     ``None`` traces the replicated graph unchanged (``--zero1 off`` HLO
     identity, tests/test_zero1.py).
+
+    ``scfg.fused_update`` replaces the whole tail of the step — the optax
+    chain, ``apply_updates``, and the EMA tick (~3 full-parameter
+    elementwise HBM sweeps) — with the fused Pallas kernel
+    (ops/fused_update.py): a flat segment-norm pass feeding one fused
+    apply pass, shard-local on the ZeRO-1 layout when ``zero1_ctx`` is
+    set.  It reads/ticks the SAME opt_state pytree (momentum trace +
+    schedule count, located by node type in optim/factory.py), so
+    checkpoints, shardings, and telemetry are layout-identical either
+    way.  Requires ``lr_schedule`` (the schedule ``tx`` closes over — the
+    kernel needs the bare lr value) and, on a multi-device mesh,
+    ``mesh`` (the kernel runs under shard_map; GSPMD cannot partition a
+    pallas_call).  False leaves the traced graph byte-identical to the
+    pre-fused-update step.
     """
     if scfg.accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {scfg.accum_steps}")
@@ -250,6 +288,25 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
         raise ValueError(
             f"unknown telemetry mode {scfg.telemetry!r}; "
             "'off' | 'epoch' | 'step'")
+    if scfg.fused_update:
+        # config resolve() rejects unsupported optimizer configs at the
+        # CLI; re-checked here for programmatic callers, plus the builder
+        # input the fused path cannot run without
+        if not scfg.lars_in_chain:
+            raise ValueError(
+                "fused_update=True with lars_in_chain=False: the fused "
+                "kernel implements the lars_momentum chain (see "
+                "optim.factory.fused_update_unsupported_reason)")
+        if scfg.clip > 0.0:
+            raise ValueError(
+                "fused_update=True with clip > 0: the optimizer chain "
+                "value-clips gradients before LARS and the fused kernel "
+                "does not replicate the clip — the two paths would "
+                "silently apply different updates")
+        if lr_schedule is None:
+            raise ValueError(
+                "fused_update=True requires lr_schedule (the schedule tx "
+                "closes over; the fused kernel needs the bare lr value)")
 
     def micro_grads(params, target_params, batch_stats, view1, view2,
                     labels):
@@ -415,42 +472,93 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
                           else accumulate_scan)
             grads, new_bs, metrics = accumulate(micro_state, xs)
 
-        if zero1_ctx is None:
-            updates, new_opt_state = tx.update(grads, state.opt_state,
-                                               state.params)
-            new_params = optax.apply_updates(state.params, updates)
+        if scfg.fused_update:
+            # Fused LARS+EMA update (ops/fused_update.py): trust ratios
+            # from the kernel's segment-norm pass, then wd fold-in +
+            # trust scaling + momentum tick + param write + EMA tick in
+            # ONE pass over the flat buffer — replacing the optax chain,
+            # apply_updates, AND the EMA tree_map below (~3 elementwise
+            # HBM sweeps -> ~1).  The momentum trace and schedule count
+            # are read from / written back into the SAME opt_state pytree
+            # the unfused chain uses (optim/factory.py locates them by
+            # node type), so checkpoints and shardings are identical.
+            from byol_tpu.optim import factory as factory_lib
+            from byol_tpu.ops import fused_update as fused_lib
+            trace, count = factory_lib.extract_sgdm_state(state.opt_state)
+            fused_lr = lr_schedule(count)
+            tau = cosine_ema_decay(state.ema_step, scfg.total_train_steps,
+                                   scfg.base_decay)
+            ema_pre = scfg.ema_update_mode == "reference_pre"
+            if zero1_ctx is None:
+                new_params, new_trace, new_target, fused_trust = \
+                    fused_lib.fused_lars_ema_update(
+                        state.params, grads, trace, state.target_params,
+                        lr=fused_lr, tau=tau,
+                        weight_decay=scfg.weight_decay,
+                        momentum_decay=factory_lib.MOMENTUM_DECAY,
+                        ema_pre=ema_pre, mesh=mesh)
+            else:
+                # shard-local kernel on the ZeRO-1 flat layout: each chip
+                # updates its 1/N of the buffer, segment norms psum over
+                # the data axis, and the one just-in-time all-gather of
+                # fresh params below is unchanged from the unfused path
+                flat_params = zero1_ctx.shard(state.params)
+                flat_grads = zero1_ctx.shard(grads)
+                new_params_flat, new_trace, new_target, fused_trust = \
+                    fused_lib.fused_lars_ema_update_zero1(
+                        flat_params, flat_grads, trace,
+                        state.target_params,
+                        param_template=zero1_ctx.param_template,
+                        mesh=zero1_ctx.mesh,
+                        num_shards=zero1_ctx.num_shards,
+                        lr=fused_lr, tau=tau,
+                        weight_decay=scfg.weight_decay,
+                        momentum_decay=factory_lib.MOMENTUM_DECAY,
+                        ema_pre=ema_pre)
+                new_params = zero1_ctx.gather(new_params_flat,
+                                              zero1_ctx.param_template)
+            new_opt_state = factory_lib.replace_sgdm_state(
+                state.opt_state, new_trace,
+                optax.safe_int32_increment(count))
         else:
-            # Per-shard weight update (arXiv 2004.13336): the reduced
-            # gradient and the params scatter to their flat 1/N shards
-            # (free: both are replicated, each chip keeps a slice), the
-            # optax chain runs shard-local — LARS norms are unchanged by
-            # the zero padding — and ONE all-gather rebuilds the fresh
-            # params just-in-time for the next forward.
-            flat_params = zero1_ctx.shard(state.params)
-            flat_grads = zero1_ctx.shard(grads)
-            updates, new_opt_state = tx.update(flat_grads, state.opt_state,
-                                               flat_params)
-            new_params_flat = optax.apply_updates(flat_params, updates)
-            new_params = zero1_ctx.gather(new_params_flat,
-                                          zero1_ctx.param_template)
+            if zero1_ctx is None:
+                updates, new_opt_state = tx.update(grads, state.opt_state,
+                                                   state.params)
+                new_params = optax.apply_updates(state.params, updates)
+            else:
+                # Per-shard weight update (arXiv 2004.13336): the reduced
+                # gradient and the params scatter to their flat 1/N shards
+                # (free: both are replicated, each chip keeps a slice), the
+                # optax chain runs shard-local — LARS norms are unchanged by
+                # the zero padding — and ONE all-gather rebuilds the fresh
+                # params just-in-time for the next forward.
+                flat_params = zero1_ctx.shard(state.params)
+                flat_grads = zero1_ctx.shard(grads)
+                updates, new_opt_state = tx.update(flat_grads,
+                                                   state.opt_state,
+                                                   flat_params)
+                new_params_flat = optax.apply_updates(flat_params, updates)
+                new_params = zero1_ctx.gather(new_params_flat,
+                                              zero1_ctx.param_template)
 
-        # Cosine-annealed EMA of the full tree (main.py:156-162,255).
-        tau = cosine_ema_decay(state.ema_step, scfg.total_train_steps,
-                               scfg.base_decay)
-        if zero1_ctx is None:
-            ema_src = (state.params
-                       if scfg.ema_update_mode == "reference_pre"
-                       else new_params)
-        else:
-            # the tick is elementwise, so it runs on the flat shards and
-            # the target STAYS sharded — it is re-gathered at the top of
-            # the next step, just-in-time for the target forward
-            ema_src = (flat_params
-                       if scfg.ema_update_mode == "reference_pre"
-                       else new_params_flat)
-        new_target = jax.tree_util.tree_map(
-            lambda t, p: tau * t + (1.0 - tau) * p,
-            state.target_params, ema_src)
+            # Cosine-annealed EMA of the full tree (main.py:156-162,255).
+            tau = cosine_ema_decay(state.ema_step, scfg.total_train_steps,
+                                   scfg.base_decay)
+            if zero1_ctx is None:
+                ema_src = (state.params
+                           if scfg.ema_update_mode == "reference_pre"
+                           else new_params)
+            else:
+                # the tick is elementwise, so it runs on the flat shards
+                # and the target STAYS sharded — it is re-gathered at the
+                # top of the next step, just-in-time for the target
+                # forward
+                ema_src = (flat_params
+                           if scfg.ema_update_mode == "reference_pre"
+                           else new_params_flat)
+            new_target = jax.tree_util.tree_map(
+                lambda t, p: tau * t + (1.0 - tau) * p,
+                state.target_params, ema_src)
 
         new_polyak = state.polyak_params
         if scfg.polyak_ema > 0.0 and state.polyak_params is not None:
@@ -478,7 +586,17 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
             # "applied" value.  Residual caveat: --clip > 0 clips before
             # LARS and is not replicated (value clipping is off in every
             # recipe this telemetry targets).
-            if scfg.lars_in_chain:
+            if scfg.fused_update:
+                # the kernel's OWN segment norms produced these ratios —
+                # reported == applied by construction, no recompute (and
+                # no second set of norm reductions in the graph).  The
+                # update the kernel wrote is -lr * m_new; rebuilding it
+                # from the fresh trace costs one telemetry-only sweep,
+                # exactly like the unfused trust recompute above.
+                trust = fused_trust
+                updates = jax.tree_util.tree_map(
+                    lambda m: -fused_lr * m, new_trace)
+            elif scfg.lars_in_chain:
                 wd_tx = lars_lib.lars_weight_decay(scfg.weight_decay)
                 trust_grads, _ = wd_tx.update(
                     grads, wd_tx.init(state.params), state.params)

@@ -58,6 +58,22 @@ def guard_steps(fn):
     return guarded
 
 
+def tree_maxdiff(a, b):
+    """Max abs elementwise difference over two pytrees' paired leaves (fp32
+    compare) — the parity comparator test_zero1.py and test_fused_update.py
+    share."""
+    import numpy as np
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return max(
+        float(np.max(np.abs(np.asarray(x, np.float32)
+                            - np.asarray(y, np.float32))))
+        if np.asarray(x).size else 0.0
+        for x, y in zip(la, lb))
+
+
 @pytest.fixture(scope="session")
 def step_guard():
     """Fixture handle for :func:`guard_steps` (importable directly as

@@ -1,0 +1,19 @@
+"""GL109 must fire: pallas_call with no interpret= fallback.
+
+A kernel spelled like this compiles Mosaic-only — CPU tier-1 and CI can
+never execute it, so its numerics are untested off-TPU.
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _double_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def double(x):
+    return pl.pallas_call(                       # no interpret= anywhere
+        _double_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

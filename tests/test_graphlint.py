@@ -43,6 +43,7 @@ RULE_CASES = [
     ("GL106", "bad_cli_drift.py", "ok_cli_drift.py"),
     ("GL107", "bad_sharding_axes.py", "ok_sharding_axes.py"),
     ("GL108", "bad_collective_vmap.py", "ok_collective_vmap.py"),
+    ("GL109", "bad_pallas_interpret.py", "ok_pallas_interpret.py"),
 ]
 
 
@@ -78,6 +79,51 @@ class TestRuleCorpus:
                 f"{rule_id} missing from corpus sweep: "
                 f"{payload['counts_by_rule']}")
         assert payload["clean"] is False
+
+
+class TestPallasLocationArm:
+    """GL109's second arm: a pallas_call INSIDE the byol_tpu package but
+    outside byol_tpu/ops/ is a finding even with interpret= plumbed (the
+    fixture corpus lives outside the package, so it can only exercise the
+    interpret arm)."""
+
+    KERNEL = ("import jax\n"
+              "from jax.experimental import pallas as pl\n\n\n"
+              "def _k(x_ref, o_ref):\n"
+              "    o_ref[...] = x_ref[...]\n\n\n"
+              "def f(x, interpret=False):\n"
+              "    return pl.pallas_call(\n"
+              "        _k, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),\n"
+              "        interpret=interpret)(x)\n")
+
+    def test_kernel_outside_ops_fires(self, tmp_path):
+        mod = tmp_path / "byol_tpu" / "models" / "sneaky.py"
+        mod.parent.mkdir(parents=True)
+        mod.write_text(self.KERNEL)
+        findings = run_rule(mod, "GL109")
+        assert findings and "outside byol_tpu/ops/" in findings[0].message
+
+    def test_kernel_inside_ops_is_clean(self, tmp_path):
+        mod = tmp_path / "byol_tpu" / "ops" / "fine.py"
+        mod.parent.mkdir(parents=True)
+        mod.write_text(self.KERNEL)
+        assert run_rule(mod, "GL109") == []
+
+    def test_kwargs_splat_stands_down(self, tmp_path):
+        """A call forwarding **kwargs may carry interpret= invisibly —
+        the zero-false-positive contract says stand down, not guess."""
+        mod = tmp_path / "byol_tpu" / "ops" / "fwd.py"
+        mod.parent.mkdir(parents=True)
+        mod.write_text(
+            "import jax\n"
+            "from jax.experimental import pallas as pl\n\n\n"
+            "def _k(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...]\n\n\n"
+            "def f(x, **kw):\n"
+            "    return pl.pallas_call(\n"
+            "        _k, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),\n"
+            "        **kw)(x)\n")
+        assert run_rule(mod, "GL109") == []
 
 
 class TestEngineSemantics:

@@ -33,7 +33,7 @@ from byol_tpu.parallel import zero1 as zero1_lib
 from byol_tpu.parallel.compile_plan import build_plan
 from byol_tpu.parallel.mesh import DATA_AXIS, shard_batch_to_mesh
 from byol_tpu.training.build import setup_training
-from tests.conftest import guard_steps
+from tests.conftest import guard_steps, tree_maxdiff as _tree_maxdiff
 
 BATCH = 16
 IMAGE = 16
@@ -85,17 +85,6 @@ def _run_arm(mesh, zero1, accum, n=3):
     return (plan, state, plan.to_canonical(state),
             {k: float(v) for k, v in metrics.items()},
             float(ev["loss_mean"]))
-
-
-def _tree_maxdiff(a, b):
-    la = jax.tree_util.tree_leaves(a)
-    lb = jax.tree_util.tree_leaves(b)
-    assert len(la) == len(lb)
-    diffs = [float(np.max(np.abs(np.asarray(x, np.float32)
-                                 - np.asarray(y, np.float32))))
-             if np.asarray(x).size else 0.0
-             for x, y in zip(la, lb)]
-    return max(diffs)
 
 
 # ---------------------------------------------------------------------------
