@@ -89,6 +89,15 @@ Usage:
                                    #   must scale ~1/N with mesh size).
                                    #   --cpu-devices N sizes the virtual
                                    #   CPU mesh for off-hardware captures
+  python bench.py --serve-ladder   # embedding-service latency/throughput
+                                   #   at 1/8/64 closed-loop streams;
+                                   #   --serve-pipeline off|on|ab A/Bs the
+                                   #   worker dispatch pipelining on the
+                                   #   same warmed engine
+  python bench.py --wire-ladder    # the WIRE TAX: in-process vs
+                                   #   over-HTTP (serving/net/) per rung —
+                                   #   client-observed p50/p99 for both
+                                   #   arms and the per-rung delta
 
 Every run also appends structured events (run header + one ``bench_row``
 per measured config) to ``bench_events.jsonl`` — the same schema-versioned
@@ -623,7 +632,7 @@ def main():
         mode = {"--sweep", "--profile", "--stem-ab", "--mvc",
                 "--accum-ladder", "--dry-compile", "--input-ladder",
                 "--telemetry-ab", "--spans-ab", "--zero1-ab",
-                "--fused-ab", "--serve-ladder"} \
+                "--fused-ab", "--serve-ladder", "--wire-ladder"} \
             & set(sys.argv[1:])
         if mode:
             # only the headline has a committed artifact to fall back to;
@@ -765,6 +774,9 @@ def main():
         return
     if "--serve-ladder" in sys.argv[1:]:
         _serve_ladder(arch, image_size, on_tpu, attn_impl)
+        return
+    if "--wire-ladder" in sys.argv[1:]:
+        _wire_ladder(arch, image_size, on_tpu, attn_impl)
         return
 
     value = best_throughput("tpu_first", half=True, fuse_views=True,
@@ -1920,33 +1932,14 @@ def _fused_ab(arch, image_size, on_tpu, attn_impl):
     }))
 
 
-def _serve_ladder(arch, image_size, on_tpu, attn_impl):
-    """Serve ladder (``--serve-ladder``): latency vs throughput for the
-    embedding service (byol_tpu/serving/) at 1/8/64 concurrent synthetic
-    client streams.
-
-    Each rung drives a closed-loop budget of single-image requests through
-    the FULL serving stack — bounded queue, request coalescing, bucket
-    padding, pinned-host staging, AOT embed, readback — and records the
-    request-latency tail (p50/p99 ms), achieved rows/sec, batch fill
-    ratio, and the engine compile counter.  The counter column is the
-    zero-recompile contract made visible: after the warmup phase it must
-    not move, or a rung's latency includes XLA compiles (the GL102 hazard
-    on the latency path) and the row says so.
-
-    CPU-runnable with ``--cpu-devices N`` (random-init encoder — latency
-    is independent of parameter values); on TPU the same command measures
-    the real serving config.  Knobs: ``--serve-streams 1,8,64``,
-    ``--serve-requests <budget/rung>``, ``--serve-max-batch``,
-    ``--serve-min-bucket``, ``--serve-wait-ms``.
-    """
-    import time
-
+def _serve_setup(arch, image_size, on_tpu):
+    """Shared --serve-ladder/--wire-ladder startup: validate the bucket/
+    mesh constraints, build the config + serve config, return everything
+    a rung loop needs.  One helper so the two ladders cannot drift."""
     from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
                                       TaskConfig)
     from byol_tpu.parallel.mesh import MeshSpec, build_mesh
-    from byol_tpu.serving.cli import _synthetic_clients
-    from byol_tpu.serving.service import ServeConfig, build_service
+    from byol_tpu.serving.service import ServeConfig
 
     streams_list = [int(s) for s in
                     _str_flag("--serve-streams", "1,8,64").split(",")]
@@ -1958,7 +1951,7 @@ def _serve_ladder(arch, image_size, on_tpu, attn_impl):
         # engine divisibility error after the model is already built:
         # buckets are powers of two and shard their rows over the mesh
         raise SystemExit(
-            f"bench: --serve-ladder needs a power-of-two device count "
+            f"bench: serve ladders need a power-of-two device count "
             f"(got {n_dev}): bucket shapes are powers of two and must "
             "shard evenly over the data axis; pass --cpu-devices 2|4|8|...")
     min_bucket = _int_flag("--serve-min-bucket", max(8, n_dev))
@@ -1980,59 +1973,125 @@ def _serve_ladder(arch, image_size, on_tpu, attn_impl):
     serve_cfg = ServeConfig(min_bucket=min_bucket, max_bucket=max_batch,
                             max_wait_ms=wait_ms,
                             stats_interval_s=1e9)   # rows emit explicitly
-    service = build_service(cfg, serve_cfg, mesh=mesh)
-    t0 = time.perf_counter()
-    service.start()           # AOT-compiles the whole bucket vocabulary
-    warm_compiles = service.engine.compile_count
-    warmup_s = time.perf_counter() - t0
-    print(f"bench: serve warmup: {warm_compiles} bucket programs "
-          f"{list(service.engine.buckets.sizes)} in {warmup_s:.1f}s",
-          file=sys.stderr)
-    shape = service.engine.input_shape
+    return (streams_list, budget, max_batch, min_bucket, wait_ms, half,
+            n_dev, mesh, cfg, serve_cfg)
+
+
+def _serve_ladder(arch, image_size, on_tpu, attn_impl):
+    """Serve ladder (``--serve-ladder``): latency vs throughput for the
+    embedding service (byol_tpu/serving/) at 1/8/64 concurrent synthetic
+    client streams.
+
+    Each rung drives a closed-loop budget of single-image requests through
+    the FULL serving stack — bounded queue, request coalescing, bucket
+    padding, pinned-host staging, AOT embed, readback — and records the
+    request-latency tail (p50/p99 ms), achieved rows/sec, batch fill
+    ratio, and the engine compile counter.  The counter column is the
+    zero-recompile contract made visible: after the warmup phase it must
+    not move, or a rung's latency includes XLA compiles (the GL102 hazard
+    on the latency path) and the row says so.
+
+    CPU-runnable with ``--cpu-devices N`` (random-init encoder — latency
+    is independent of parameter values); on TPU the same command measures
+    the real serving config.  Knobs: ``--serve-streams 1,8,64``,
+    ``--serve-requests <budget/rung>``, ``--serve-max-batch``,
+    ``--serve-min-bucket``, ``--serve-wait-ms``, and ``--serve-pipeline
+    off|on|ab`` — 'ab' re-runs the whole ladder with worker dispatch
+    pipelining off then on (same engine, same executables: the delta is
+    pure host/device overlap), the ISSUE 13 before/after row.
+    """
+    import dataclasses
+    import time
+
+    from byol_tpu.serving.batcher import DynamicBatcher
+    from byol_tpu.serving.net.loadgen import run_closed_loop
+    from byol_tpu.serving.service import EmbeddingService, build_service
+
+    (streams_list, budget, max_batch, min_bucket, wait_ms, half,
+     n_dev, mesh, cfg, serve_cfg) = _serve_setup(arch, image_size, on_tpu)
+    pipe_flag = _str_flag("--serve-pipeline", "on")
+    if pipe_flag not in ("off", "on", "ab"):
+        raise SystemExit("usage: bench.py --serve-ladder "
+                         "--serve-pipeline off|on|ab")
+    arms = ("off", "on") if pipe_flag == "ab" else (pipe_flag,)
+
+    engine = None
+    warmup_s = 0.0
     ladder = []
-    try:
-        for n_streams in streams_list:
-            # untimed warm pass: first execution of each bucket program
-            # pays one-time backend setup that is not steady-state latency
-            _synthetic_clients(service, max(2 * n_streams, 8), n_streams,
-                               shape, seed=17)
-            service.meter.snapshot(time.perf_counter())   # reset window
-            rung_base = service.engine.compile_count  # per-rung baseline:
-            t1 = time.perf_counter()                  # a compile counts in
-            done = _synthetic_clients(service, budget, n_streams, shape,
-                                      seed=n_streams)  # the rung it ran in
-            elapsed = time.perf_counter() - t1
-            recompiles = service.engine.compile_count - rung_base
-            # one serve_stats event per rung next to the bench_row — the
-            # serving schema exercised by the same capture CI validates
-            snap = service.meter.emit(
-                _events, time.perf_counter(), streams=n_streams,
-                compile_count=service.engine.compile_count)
-            row = {
-                "streams": n_streams, "requests": done,
-                "p50_ms": round(snap["p50_ms"], 3),
-                "p99_ms": round(snap["p99_ms"], 3),
-                "mean_ms": round(snap["mean_ms"], 3),
-                "throughput_img_per_sec": round(done / elapsed, 2),
-                "throughput_img_per_sec_per_chip":
-                    round(done / elapsed / n_dev, 2),
-                "fill_ratio": round(snap["fill_ratio"], 4),
-                "queue_depth": round(snap["queue_depth"], 2),
-                "batches": int(snap["batches"]),
-                "recompiles_after_warmup": recompiles,
-                "max_batch": max_batch, "min_bucket": min_bucket,
-                "max_wait_ms": wait_ms, "n_devices": n_dev,
-                "half": half, "warmup_compile_seconds": round(warmup_s, 2),
-            }
-            ladder.append(row)
-            _record(f"serve_s{n_streams}", fit=True, **row)
-            print(f"bench: serve s{n_streams}: p50 {row['p50_ms']}ms "
-                  f"p99 {row['p99_ms']}ms "
-                  f"{row['throughput_img_per_sec']} img/s "
-                  f"fill {row['fill_ratio']} "
-                  f"recompiles {recompiles}", file=sys.stderr)
-    finally:
-        service.stop()
+    for pipeline in arms:
+        if engine is None:
+            service = build_service(
+                cfg, dataclasses.replace(serve_cfg, pipeline=pipeline),
+                mesh=mesh)
+            engine = service.engine
+            t0 = time.perf_counter()
+            service.start()   # AOT-compiles the whole bucket vocabulary
+            warmup_s = time.perf_counter() - t0
+            print(f"bench: serve warmup: {engine.compile_count} bucket "
+                  f"programs {list(engine.buckets.sizes)} in "
+                  f"{warmup_s:.1f}s", file=sys.stderr)
+        else:
+            # second arm reuses the warmed ENGINE (identical executables
+            # — the A/B delta is worker overlap, not compilation) under a
+            # fresh batcher/worker
+            service = EmbeddingService(
+                engine,
+                DynamicBatcher(max_batch=max_batch,
+                               max_queue=serve_cfg.max_queue,
+                               max_wait_s=wait_ms / 1e3),
+                stats_interval_s=1e9, pipeline=pipeline)
+            service.start(warmup=False)
+        shape = engine.input_shape
+        try:
+            for n_streams in streams_list:
+                # untimed warm pass: first execution of each bucket
+                # program pays one-time backend setup that is not
+                # steady-state latency
+                run_closed_loop(
+                    lambda i, img: service.embed(img, timeout=600.0),
+                    shape, max(2 * n_streams, 8), n_streams, seed=17)
+                service.meter.snapshot(time.perf_counter())  # reset window
+                rung_base = engine.compile_count  # per-rung baseline: a
+                res = run_closed_loop(            # compile counts in the
+                    lambda i, img: service.embed(img, timeout=600.0),
+                    shape, budget, n_streams,     # rung it ran in
+                    seed=n_streams)
+                done, elapsed = res.completed, res.elapsed_s
+                recompiles = engine.compile_count - rung_base
+                # one serve_stats event per rung next to the bench_row —
+                # the serving schema exercised by the capture CI validates
+                snap = service.meter.emit(
+                    _events, time.perf_counter(), streams=n_streams,
+                    compile_count=engine.compile_count)
+                row = {
+                    "streams": n_streams, "requests": done,
+                    "failed": res.failed,
+                    "pipeline": pipeline,
+                    "p50_ms": round(snap["p50_ms"], 3),
+                    "p99_ms": round(snap["p99_ms"], 3),
+                    "mean_ms": round(snap["mean_ms"], 3),
+                    "throughput_img_per_sec": round(done / elapsed, 2),
+                    "throughput_img_per_sec_per_chip":
+                        round(done / elapsed / n_dev, 2),
+                    "fill_ratio": round(snap["fill_ratio"], 4),
+                    "queue_depth": round(snap["queue_depth"], 2),
+                    "batches": int(snap["batches"]),
+                    "recompiles_after_warmup": recompiles,
+                    "max_batch": max_batch, "min_bucket": min_bucket,
+                    "max_wait_ms": wait_ms, "n_devices": n_dev,
+                    "half": half,
+                    "warmup_compile_seconds": round(warmup_s, 2),
+                }
+                ladder.append(row)
+                _record(f"serve_s{n_streams}_pipe_{pipeline}", fit=True,
+                        **row)
+                print(f"bench: serve s{n_streams} pipe={pipeline}: "
+                      f"p50 {row['p50_ms']}ms p99 {row['p99_ms']}ms "
+                      f"{row['throughput_img_per_sec']} img/s "
+                      f"fill {row['fill_ratio']} "
+                      f"recompiles {recompiles}", file=sys.stderr)
+        finally:
+            service.stop()
     print(json.dumps({
         "metric": "serve_ladder_p99_ms",
         "value": ladder[-1]["p99_ms"] if ladder else None,
@@ -2043,6 +2102,128 @@ def _serve_ladder(arch, image_size, on_tpu, attn_impl):
         "n_devices": n_dev,
         "recompiles_after_warmup": sum(r["recompiles_after_warmup"]
                                        for r in ladder),
+        "rows": ladder,
+    }))
+
+
+def _wire_ladder(arch, image_size, on_tpu, attn_impl):
+    """Wire ladder (``--wire-ladder``): the WIRE TAX measured — the same
+    closed-loop streams driven twice per rung, once through the
+    in-process ``service.embed`` path and once over HTTP through the
+    serving/net front end (protocol encode → POST /v1/embed → decode),
+    against ONE warmed service.  Client-observed p50/p99 per arm; the
+    per-rung delta is what the network front door costs on top of the
+    batching/AOT machinery (localhost floor — real networks add RTT on
+    top, but the protocol + HTTP + framing overhead is all here).
+
+    Knobs: the --serve-* family (shared with --serve-ladder) plus
+    ``--wire-deadline-ms`` (per-request X-Deadline-Ms; generous default —
+    the ladder measures latency, not admission policy).
+    """
+    import time
+
+    from byol_tpu.serving.net.client import EmbedClient
+    from byol_tpu.serving.net.loadgen import run_closed_loop
+    from byol_tpu.serving.net.server import WireServer
+    from byol_tpu.serving.service import build_service
+
+    (streams_list, budget, max_batch, min_bucket, wait_ms, half,
+     n_dev, mesh, cfg, serve_cfg) = _serve_setup(arch, image_size, on_tpu)
+    deadline_ms = float(_str_flag("--wire-deadline-ms", "600000"))
+
+    service = build_service(cfg, serve_cfg, mesh=mesh)
+    t0 = time.perf_counter()
+    service.start()           # AOT-compiles the whole bucket vocabulary
+    warmup_s = time.perf_counter() - t0
+    engine = service.engine
+    print(f"bench: wire warmup: {engine.compile_count} bucket programs "
+          f"{list(engine.buckets.sizes)} in {warmup_s:.1f}s",
+          file=sys.stderr)
+    server = WireServer(service, "127.0.0.1", 0,
+                        default_deadline_ms=deadline_ms).start()
+    host, port = server.address
+    print(f"bench: wire front end at http://{host}:{port}",
+          file=sys.stderr)
+    shape = engine.input_shape
+    ladder = []
+
+    def inproc_fn(idx, img):
+        service.embed(img, timeout=deadline_ms / 1e3)
+
+    clients = {}
+
+    def wire_setup(idx):
+        # create-if-absent: the warm pass dials each stream's connection
+        # and the measured pass must REUSE it — re-dialing here would put
+        # the TCP connect the warm pass exists to absorb back into the
+        # first measured sample of every stream (at 64 streams / 256
+        # requests that is a quarter of the published p99's samples)
+        if idx not in clients:
+            clients[idx] = EmbedClient(host, port,
+                                       timeout_s=deadline_ms / 1e3 + 5.0,
+                                       seed=idx)
+
+    def wire_fn(idx, img):
+        clients[idx].embed(img, deadline_ms=deadline_ms)
+
+    try:
+        for n_streams in streams_list:
+            rows_by_arm = {}
+            for arm, fn, setup in (("inproc", inproc_fn, None),
+                                   ("wire", wire_fn, wire_setup)):
+                # untimed warm pass (per arm: the wire arm's first
+                # requests also pay connection dialing)
+                run_closed_loop(fn, shape, max(2 * n_streams, 8),
+                                n_streams, seed=17, stream_setup=setup)
+                service.meter.snapshot(time.perf_counter())  # reset
+                rung_base = engine.compile_count
+                res = run_closed_loop(fn, shape, budget, n_streams,
+                                      seed=n_streams, stream_setup=setup)
+                snap = service.meter.emit(
+                    _events, time.perf_counter(), streams=n_streams,
+                    arm=arm, compile_count=engine.compile_count)
+                row = {
+                    "streams": n_streams, "arm": arm,
+                    "requests": res.completed, "failed": res.failed,
+                    # CLIENT-observed latency (loadgen's clock): the
+                    # meter's enqueue->deliver window cannot see wire
+                    # time by construction
+                    "p50_ms": round(res.percentile_ms(50), 3),
+                    "p99_ms": round(res.percentile_ms(99), 3),
+                    "throughput_img_per_sec":
+                        round(res.throughput(), 2),
+                    "serve_p50_ms": round(snap["p50_ms"], 3),
+                    "fill_ratio": round(snap["fill_ratio"], 4),
+                    "recompiles_after_warmup":
+                        engine.compile_count - rung_base,
+                    "max_batch": max_batch, "min_bucket": min_bucket,
+                    "max_wait_ms": wait_ms, "n_devices": n_dev,
+                    "half": half,
+                }
+                rows_by_arm[arm] = row
+                ladder.append(row)
+                _record(f"wire_s{n_streams}_{arm}", fit=True, **row)
+            tax_p50 = round(rows_by_arm["wire"]["p50_ms"]
+                            - rows_by_arm["inproc"]["p50_ms"], 3)
+            tax_p99 = round(rows_by_arm["wire"]["p99_ms"]
+                            - rows_by_arm["inproc"]["p99_ms"], 3)
+            print(f"bench: wire s{n_streams}: inproc p50 "
+                  f"{rows_by_arm['inproc']['p50_ms']}ms, wire p50 "
+                  f"{rows_by_arm['wire']['p50_ms']}ms -> tax "
+                  f"{tax_p50}ms (p99 tax {tax_p99}ms)", file=sys.stderr)
+    finally:
+        for c in clients.values():
+            c.close()
+        server.drain(grace_s=0.0, timeout_s=60.0)   # stops the service
+    print(json.dumps({
+        "metric": "wire_ladder_p50_tax_ms",
+        "value": (round(ladder[-1]["p50_ms"] - ladder[-2]["p50_ms"], 3)
+                  if len(ladder) >= 2 else None),
+        "unit": "ms wire-minus-inproc @ most-concurrent rung",
+        "vs_baseline": None,
+        "arch": arch, "image_size": image_size,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": n_dev,
         "rows": ladder,
     }))
 

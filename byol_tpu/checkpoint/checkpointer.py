@@ -26,6 +26,28 @@ import orbax.checkpoint as ocp
 _STEP_RE = re.compile(r"^ckpt-(\d+)$")
 _META = "meta.json"
 
+# strict-JSON round trip for meta (GL110): a NaN eval metric must not
+# become a bare NaN token in meta.json (strict parsers reject it) NOR
+# crash the save that records it — non-finite floats write via
+# events.sanitize (the convention's owner) and read back as the floats
+# they were.  Restore is scoped to the keys this module WRITES floats
+# under: sanitize is not injective, so a user-supplied string that
+# merely spells "NaN" in any other field must survive verbatim.
+_NONFINITE_STR = {"NaN": float("nan"), "Infinity": float("inf"),
+                  "-Infinity": float("-inf")}
+_NUMERIC_META_KEYS = frozenset({"metric", "best_metric"})
+
+
+def _meta_restore(obj: Any, key: Optional[str] = None) -> Any:
+    if isinstance(obj, dict):
+        return {k: _meta_restore(v, k) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_meta_restore(v, key) for v in obj]
+    if (key in _NUMERIC_META_KEYS and isinstance(obj, str)
+            and obj in _NONFINITE_STR):
+        return _NONFINITE_STR[obj]
+    return obj
+
 
 def _is_primary() -> bool:
     return jax.process_index() == 0
@@ -51,16 +73,18 @@ class CheckpointStore:
     def read_meta(self) -> Dict[str, Any]:
         try:
             with open(self._meta_path()) as f:
-                return json.load(f)
+                return _meta_restore(json.load(f))
         except (FileNotFoundError, json.JSONDecodeError):
             return {}
 
     def write_meta(self, meta: Dict[str, Any]) -> None:
         if not _is_primary():
             return
+        from byol_tpu.observability.events import sanitize
         tmp = self._meta_path() + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(meta, f, indent=2, sort_keys=True)
+            json.dump(sanitize(meta), f, indent=2, sort_keys=True,
+                      allow_nan=False)
         os.replace(tmp, self._meta_path())
 
     # -- checkpoints -------------------------------------------------------

@@ -267,7 +267,11 @@ class Config:
         return dataclasses.asdict(self)
 
     def to_json(self) -> str:
-        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+        # config scalars are user-supplied finite knobs; a NaN landing in
+        # one is a bug worth a loud ValueError, not a bare token in the
+        # serialized config (GL110 strict-JSON discipline)
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True,
+                          allow_nan=False)
 
 
 @_frozen

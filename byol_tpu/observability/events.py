@@ -74,18 +74,23 @@ SHARDING_PLAN_FIELDS = ("mesh_shape", "axis_names", "zero1",
                         "donate_argnums")
 
 
-def _sanitize(obj: Any) -> Any:
+def sanitize(obj: Any) -> Any:
     """JSON-strict deep copy of a payload: non-finite floats become the
     strings ``"NaN"`` / ``"Infinity"`` / ``"-Infinity"``.  Run on every
     event before ``json.dumps(..., allow_nan=False)`` so the lines a NaN
     run produces — the ones this log exists to capture — stay parseable
-    by every standard JSON consumer, not just Python's lenient reader."""
+    by every standard JSON consumer, not just Python's lenient reader.
+
+    This module OWNS the convention (GL110): every other strict-JSON
+    writer — grapher metrics lines, span chrome-trace attrs, checkpoint
+    meta.json, the wire /statsz endpoint — delegates here rather than
+    growing a drift-prone copy of the mapping."""
     if isinstance(obj, dict):
-        return {k: _sanitize(v) for k, v in obj.items()}
+        return {k: sanitize(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
-        return [_sanitize(v) for v in obj]
+        return [sanitize(v) for v in obj]
     if isinstance(obj, np.ndarray):
-        return _sanitize(obj.tolist())
+        return sanitize(obj.tolist())
     if isinstance(obj, (float, np.floating)):
         f = float(obj)
         if math.isnan(f):
@@ -94,6 +99,10 @@ def _sanitize(obj: Any) -> Any:
             return "Infinity" if f > 0 else "-Infinity"
         return f
     return obj
+
+
+# internal call sites predate the public promotion
+_sanitize = sanitize
 
 
 def _json_default(obj: Any):

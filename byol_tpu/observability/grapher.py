@@ -33,6 +33,15 @@ _SCALAR_RE = re.compile(r".*(_mean|_scalar)$")
 _IMAGE_RE = re.compile(r".*_imgs?$")
 
 
+def _finite_or_str(value: float) -> Any:
+    """Strict-JSON scalar (GL110): a diverged run's NaN loss must land in
+    metrics.jsonl as the string ``"NaN"`` — parseable evidence — not as a
+    bare token that breaks every strict reader downstream.  Delegates to
+    the convention's owner, :func:`observability.events.sanitize`."""
+    from byol_tpu.observability.events import sanitize
+    return sanitize(float(value))
+
+
 def is_scalar_key(key: str) -> bool:
     return bool(_SCALAR_RE.match(key))
 
@@ -71,7 +80,8 @@ class Grapher:
             self._tb.add_scalar(key, float(value), step)
         if self._jsonl is not None:
             self._jsonl.write(json.dumps(
-                {"t": time.time(), "step": step, key: float(value)}) + "\n")
+                {"t": time.time(), "step": step,
+                 key: _finite_or_str(value)}, allow_nan=False) + "\n")
 
     def add_image(self, key: str, grid: np.ndarray, step: int) -> None:
         """grid: (H, W, C) float [0,1]."""
@@ -84,7 +94,8 @@ class Grapher:
             self._tb.add_text(key, text, step)
         if self._jsonl is not None:
             self._jsonl.write(json.dumps(
-                {"t": time.time(), "step": step, key: text}) + "\n")
+                {"t": time.time(), "step": step, key: text},
+                allow_nan=False) + "\n")
 
     def save(self) -> None:
         if self._tb is not None:

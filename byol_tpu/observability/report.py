@@ -181,6 +181,35 @@ def render(events: List[Dict[str, Any]], *,
                 share = mean / total_ms if total_ms > 0 else 0.0
                 out.append(f"   {k:<20} {mean:8.2f}ms {share:7.1%}  "
                            f"{_bar(share)}")
+        # wire-layer block (serving/net): HTTP status histogram +
+        # request-weighted read/parse/wait/write means across windows
+        status_tot: Dict[str, float] = {}
+        wire_tot: Dict[str, float] = {}
+        wire_w = 0.0
+        for e in serves:
+            wire = e.get("wire") or {}
+            w = _num(wire.get("http_requests")) or 0.0
+            if w <= 0:
+                continue
+            wire_w += w
+            for k, v in (wire.get("status") or {}).items():
+                fv = _num(v)
+                if fv is not None:
+                    status_tot[k] = status_tot.get(k, 0.0) + fv
+            for k, v in (wire.get("phase_ms") or {}).items():
+                fv = _num(v)
+                if fv is not None:
+                    wire_tot[k] = wire_tot.get(k, 0.0) + fv * w
+        if wire_w > 0:
+            hist = "  ".join(f"{k}:{int(v)}"
+                             for k, v in sorted(status_tot.items()))
+            out.append(f"   wire: {int(wire_w)} HTTP answer(s)  [{hist}]")
+            total_ms = sum(wire_tot.values()) / wire_w
+            for k, v in sorted(wire_tot.items()):
+                mean = v / wire_w
+                share = mean / total_ms if total_ms > 0 else 0.0
+                out.append(f"   wire/{k:<15} {mean:8.2f}ms {share:7.1%}  "
+                           f"{_bar(share)}")
 
     anomalies = [e for e in events if e["kind"] in ("anomaly", "halt")]
     out.append("")

@@ -223,7 +223,13 @@ def span(name: str, **attrs: Any):
 # ---------------------------------------------------------------------------
 
 def _json_safe(value: Any) -> Any:
-    if isinstance(value, (str, int, float, bool)) or value is None:
+    if isinstance(value, float):
+        # strict-JSON discipline (GL110): a non-finite span attr must
+        # not become a bare NaN token chrome://tracing refuses to load —
+        # events.sanitize owns the float -> string mapping
+        from byol_tpu.observability.events import sanitize
+        return sanitize(value)
+    if isinstance(value, (str, int, bool)) or value is None:
         return value
     if isinstance(value, (list, tuple)):
         return [_json_safe(v) for v in value]
@@ -261,6 +267,9 @@ def export_chrome_trace(records: Iterable[Span], path: str, *,
     if parent:
         os.makedirs(parent, exist_ok=True)
     with open(path, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        # ts/dur come from perf_counter deltas (always finite) and attrs
+        # pass through _json_safe — strict dump so nothing lenient slips
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f,
+                  allow_nan=False)
         f.write("\n")
     return len(events) - 1

@@ -3,9 +3,13 @@
 The user-facing front end over the trained online encoder (the ROADMAP
 "Production embedding service" item): an AOT-compiled, donated, bf16 embed
 step behind a request-coalescing dynamic batcher with pad-to-power-of-two
-bucket shapes, pinned-host staging, and a latency-tail meter wired into the
-schema-versioned event log.  ``python -m byol_tpu serve`` is the CLI;
-``bench.py --serve-ladder`` is the measurement surface.
+bucket shapes, pinned-host staging, pipelined worker dispatch, and a
+latency-tail meter wired into the schema-versioned event log.  The
+``serving/net/`` subpackage is the wire front end (HTTP protocol +
+deadline-aware server + client + loadgen — imported on demand, so the
+in-process API stays free of transport concerns).  ``python -m byol_tpu
+serve [--http HOST:PORT]`` is the CLI; ``bench.py --serve-ladder`` /
+``--wire-ladder`` are the measurement surfaces.
 """
 from byol_tpu.serving.batcher import (Backpressure, DynamicBatcher, Request,
                                       ServiceClosed)

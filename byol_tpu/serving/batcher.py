@@ -47,6 +47,13 @@ LIFECYCLE_PHASES = ("enqueue", "coalesce", "stage", "dispatch",
 # through batcher -> engine spans -> future (span ``trace_ids`` attrs)
 _TRACE_IDS = itertools.count(1)
 
+# next_batch(block=False) answer for "open but no traffic right now" —
+# distinct from None ("closed AND drained"), so a pipelined worker can
+# use an idle moment to read back an in-flight batch instead of either
+# blocking (deadlocks a closed-loop client waiting on that batch) or
+# misreading quiet as shutdown
+EMPTY = object()
+
 
 class Backpressure(RuntimeError):
     """The bounded request queue stayed full past the submit timeout."""
@@ -59,11 +66,14 @@ class ServiceClosed(RuntimeError):
 class Request:
     """One embed request: ``rows`` images in, a future of embeddings out."""
 
-    def __init__(self, images: np.ndarray) -> None:
+    def __init__(self, images: np.ndarray, trace_id=None) -> None:
         self.images = images
         self.rows = int(images.shape[0])
         self.enqueued_at = time.perf_counter()
-        self.trace_id = next(_TRACE_IDS)
+        # the caller may bring its own correlation key (the wire layer's
+        # X-Request-Id becomes the serving trace id verbatim, so one id
+        # follows a request from the client's log through the span ring)
+        self.trace_id = next(_TRACE_IDS) if trace_id is None else trace_id
         self.marks: Dict[str, float] = {"enqueue": self.enqueued_at}
         self._done = threading.Event()
         self._result: Optional[np.ndarray] = None
@@ -141,12 +151,15 @@ class DynamicBatcher:
 
     # ---- client side ------------------------------------------------------
     def submit(self, images: np.ndarray,
-               timeout: Optional[float] = 1.0) -> Request:
+               timeout: Optional[float] = 1.0,
+               trace_id=None) -> Request:
         """Enqueue one request; returns its future.
 
         ``images`` is ``(rows, H, W, C)``; a single image may be passed as
         ``(H, W, C)`` and is lifted to one row.  A request larger than
         ``max_batch`` is rejected outright — it could never flush.
+        ``trace_id`` overrides the process-wide counter (the wire front
+        end passes its X-Request-Id here).
         """
         images = np.asarray(images)
         if images.ndim == 3:
@@ -161,7 +174,7 @@ class DynamicBatcher:
             raise ValueError(
                 f"request of {images.shape[0]} rows exceeds max_batch "
                 f"{self.max_batch}; split it client-side")
-        req = Request(images)
+        req = Request(images, trace_id=trace_id)
         # Nonblocking enqueue attempts under the lock, waiting OUTSIDE it:
         # holding the lock across a blocking full-queue wait would
         # serialize every saturated submitter (and close()) behind one
@@ -217,7 +230,8 @@ class DynamicBatcher:
             except queue.Empty:
                 return failed
 
-    def next_batch(self, poll_s: float = 0.05) -> Optional[List[Request]]:
+    def next_batch(self, poll_s: float = 0.05, *,
+                   block: bool = True) -> Optional[List[Request]]:
         """Dequeue one coalesced batch; ``None`` means closed AND drained.
 
         Policy: block for the first request (polling so close() is
@@ -225,15 +239,23 @@ class DynamicBatcher:
         are reached or ``max_wait_s`` has passed since the batch opened.
         A request that would overflow is carried — the flush never splits
         or reorders requests, so results map back trivially.
+
+        ``block=False`` returns :data:`EMPTY` instead of waiting when no
+        request is immediately available (and the batcher is open): the
+        pipelined worker's "anything to overlap with?" probe.  A carried
+        overflow request counts as immediately available.
         """
         first = self._carry
         self._carry = None
         while first is None:
             try:
-                first = self._q.get(timeout=poll_s)
+                first = (self._q.get(timeout=poll_s) if block
+                         else self._q.get_nowait())
             except queue.Empty:
                 if self._closed.is_set():
                     return None
+                if not block:
+                    return EMPTY
         batch, rows = [first], first.rows
         deadline = time.perf_counter() + self.max_wait_s
         while rows < self.max_batch:

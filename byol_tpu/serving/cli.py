@@ -15,17 +15,32 @@ describe.  Serving-only knobs:
     --min-bucket/--max-batch   the power-of-two bucket vocabulary
     --max-queue           bounded-queue depth (backpressure past it)
     --max-wait-ms         coalescing flush deadline
+    --pipeline off|on     worker dispatch pipelining (double-buffered
+                          stage+dispatch overlapping the device; on)
+    --http HOST:PORT      the wire front end (serving/net/): POST
+                          /v1/embed + healthz/readyz/statsz, X-Deadline-Ms
+                          admission budgets, 429/503 backpressure, SIGTERM
+                          graceful drain.  Empty = in-process only.
+    --http-deadline-ms    default per-request budget when the client
+                          sends no X-Deadline-Ms
+    --drain-grace-s       seconds /readyz answers 503 BEFORE in-flight
+                          waiting begins — the window a load balancer's
+                          readiness prober needs to evict this replica
     --serve-events PATH   serve_stats JSONL log (observability/events.py
                           schema; default <log_dir>/serve.jsonl)
     --smoke N             drive N synthetic requests through the full
                           stack from --smoke-streams client threads,
-                          print the stats line, and exit 0 — the CI wiring
+                          print the stats line, and exit — over the WIRE
+                          (with request/readiness assertions) when --http
+                          is given, in-process otherwise.  Exits NONZERO
+                          when any stream's request fails or times out —
+                          a smoke where half the requests died must not
+                          pass CI on the strength of the other half.
 
-Without --smoke the process serves until SIGINT, emitting a stats window
-every --stats-interval seconds.  (The in-process ``submit()`` API is the
-service's front door; a network listener is a thin adapter away and
-deliberately out of scope here — transport choices should not be welded
-to the batching/compile machinery.)
+Without --smoke the process serves until SIGTERM/SIGINT, then drains
+gracefully: /readyz flips to 503 immediately, --drain-grace-s elapses,
+accepted requests complete, the listener closes, and the service stops —
+every accepted request resolves before exit.
 """
 from __future__ import annotations
 
@@ -60,6 +75,24 @@ def build_serve_parser():
                         "get backpressure")
     s.add_argument("--max-wait-ms", type=float, default=5.0,
                    help="coalescing flush deadline per batch")
+    s.add_argument("--pipeline", choices=("off", "on"), default="on",
+                   help="worker dispatch pipelining: 'on' double-buffers "
+                        "stage+dispatch so the host prepares batch i+1 "
+                        "while the device computes batch i (bitwise-"
+                        "identical results; serve-ladder A/B in "
+                        "RESULTS.md)")
+    s.add_argument("--http", type=str, default="",
+                   help="bind the wire front end at HOST:PORT "
+                        "(serving/net/server.py: POST /v1/embed, GET "
+                        "/healthz|/readyz|/statsz); empty = in-process "
+                        "submit() only")
+    s.add_argument("--http-deadline-ms", type=float, default=30_000.0,
+                   help="default admission budget for requests without "
+                        "an X-Deadline-Ms header")
+    s.add_argument("--drain-grace-s", type=float, default=0.5,
+                   help="seconds /readyz serves 503 before the drain "
+                        "waits out in-flight requests (load-balancer "
+                        "eviction window)")
     s.add_argument("--stats-interval", type=float, default=10.0,
                    help="seconds between serve_stats event emits")
     s.add_argument("--serve-events", type=str, default="",
@@ -69,12 +102,15 @@ def build_serve_parser():
                    help="Chrome-trace JSON written at shutdown from the "
                         "serving flight recorder (per-batch spans with "
                         "request trace ids + engine stage/dispatch/"
-                        "readback; observability/spans.py); default "
+                        "readback + wire http/read|parse|wait|write; "
+                        "observability/spans.py); default "
                         "<log_dir>/serve_trace.json, 'off' disables "
                         "recording entirely")
     s.add_argument("--smoke", type=int, default=0,
-                   help="drive N synthetic requests through the service, "
-                        "print stats, exit (CI smoke)")
+                   help="drive N synthetic requests through the service "
+                        "(over the wire when --http is given), print "
+                        "stats, exit nonzero on ANY failed/timed-out "
+                        "request (CI smoke)")
     s.add_argument("--smoke-streams", type=int, default=4,
                    help="concurrent client threads for --smoke")
     s.add_argument("--cpu-devices", type=int, default=0,
@@ -83,42 +119,84 @@ def build_serve_parser():
     return p
 
 
-def _synthetic_clients(service, n_requests: int, n_streams: int,
-                       input_shape, seed: int = 0) -> int:
-    """Closed-loop synthetic request streams (the smoke/bench driver):
-    each stream submits single-image requests back-to-back until the
-    shared budget is spent.  Returns the number of completed requests."""
-    import threading
+def _smoke_rc(result, requested: int) -> int:
+    """The smoke gate, factored for the exit-code pin in tests/test_net:
+    ANY failed or missing request is a nonzero exit — the loadgen
+    accounts, this judges."""
+    return 0 if (result.failed == 0
+                 and result.completed == requested) else 1
 
-    import numpy as np
 
-    budget = {"left": n_requests, "done": 0}
-    lock = threading.Lock()
+def _run_smoke_inproc(service, n_requests: int, n_streams: int, *,
+                      seed: int = 0, timeout_s: float = 600.0):
+    """Closed-loop smoke through the in-process submit() path."""
+    from byol_tpu.serving.net.loadgen import run_closed_loop
 
-    def stream(idx: int) -> None:
-        rng = np.random.RandomState(seed + idx)
-        img = rng.rand(*input_shape).astype(np.float32)
-        while True:
-            with lock:
-                if budget["left"] <= 0:
-                    return
-                budget["left"] -= 1
-            service.embed(img, timeout=600.0)
-            with lock:
-                budget["done"] += 1
+    return run_closed_loop(
+        lambda idx, img: service.embed(img, timeout=timeout_s),
+        service.engine.input_shape, n_requests, n_streams, seed=seed)
 
-    threads = [threading.Thread(target=stream, args=(i,), daemon=True)
-               for i in range(max(1, n_streams))]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    return budget["done"]
+
+def _run_smoke_wire(server, n_requests: int, n_streams: int, *,
+                    seed: int = 0, deadline_ms: float = 30_000.0):
+    """Closed-loop smoke OVER THE WIRE: one connection-reusing client per
+    stream, every request carrying an explicit deadline."""
+    from byol_tpu.serving.net.client import EmbedClient
+    from byol_tpu.serving.net.loadgen import run_closed_loop
+
+    host, port = server.address
+    clients = {}
+
+    def setup(idx: int) -> None:
+        clients[idx] = EmbedClient(host, port,
+                                   timeout_s=deadline_ms / 1e3 + 5.0,
+                                   seed=seed + idx)
+
+    def embed(idx: int, img) -> None:
+        clients[idx].embed(img, deadline_ms=deadline_ms,
+                           request_id=f"smoke-{idx}")
+
+    try:
+        return run_closed_loop(
+            embed, server.input_shape, n_requests, n_streams,
+            seed=seed, stream_setup=setup)
+    finally:
+        for c in clients.values():
+            c.close()
+
+
+def _assert_drain_transition(server) -> List[str]:
+    """The lifecycle contract, checked over the REAL wire: ready before
+    drain, 503 readyz + 200 healthz DURING drain.  Returns the list of
+    violations (empty = clean); begin_drain is left set — the caller
+    finishes with server.drain()."""
+    from byol_tpu.serving.net.client import EmbedClient
+
+    host, port = server.address
+    problems: List[str] = []
+    with EmbedClient(host, port, timeout_s=10.0) as probe:
+        status, _ = probe.get("/healthz")
+        if status != 200:
+            problems.append(f"healthz {status} != 200 before drain")
+        status, _ = probe.get("/readyz")
+        if status != 200:
+            problems.append(f"readyz {status} != 200 before drain")
+        server.begin_drain()
+        status, _ = probe.get("/readyz")
+        if status != 503:
+            problems.append(f"readyz {status} != 503 during drain")
+        status, _ = probe.get("/healthz")
+        if status != 200:
+            problems.append(f"healthz {status} != 200 during drain "
+                            "(liveness must outlive readiness)")
+    return problems
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_serve_parser().parse_args(argv)
     import os
+    import signal
+    import threading
 
     from byol_tpu.core import preflight
     if args.no_cuda:
@@ -144,7 +222,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         min_bucket=args.min_bucket, max_bucket=args.max_batch,
         max_queue=args.max_queue, max_wait_ms=args.max_wait_ms,
         num_classes=args.num_classes,
-        stats_interval_s=args.stats_interval)
+        stats_interval_s=args.stats_interval,
+        pipeline=args.pipeline)
+    http_addr = None
+    if args.http:
+        from byol_tpu.serving.net.client import parse_address
+        try:
+            http_addr = parse_address(args.http)
+        except ValueError as e:
+            print(f"serve: {e}", file=sys.stderr)
+            return 2
     events_path = args.serve_events or os.path.join(cfg.task.log_dir,
                                                     "serve.jsonl")
     trace_path = args.serve_trace or os.path.join(cfg.task.log_dir,
@@ -173,7 +260,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 "min_bucket": args.min_bucket,
                                 "max_batch": args.max_batch,
                                 "max_queue": args.max_queue,
-                                "max_wait_ms": args.max_wait_ms}},
+                                "max_wait_ms": args.max_wait_ms,
+                                "pipeline": args.pipeline,
+                                "http": args.http}},
                     jax_version=jax.__version__,
                     backend=jax.default_backend())
         service = build_service(cfg, serve_cfg,
@@ -190,39 +279,81 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"program(s) {list(service.engine.buckets.sizes)} compiled "
               f"in {time.perf_counter() - t0:.1f}s; "
               f"accepting requests ({service.engine.describe()})")
-        try:
-            if args.smoke:
-                done = _synthetic_clients(
-                    service, args.smoke, args.smoke_streams,
-                    service.engine.input_shape, seed=cfg.device.seed)
-                # read the window BEFORE stop(): the final stats emit in
-                # stop() resets it
+        server = None
+        if http_addr is not None:
+            from byol_tpu.serving.net.server import WireServer
+            server = WireServer(
+                service, http_addr[0], http_addr[1],
+                default_deadline_ms=args.http_deadline_ms).start()
+            print(f"serve: wire front end at "
+                  f"http://{server.address[0]}:{server.address[1]} "
+                  "(POST /v1/embed, GET /healthz /readyz /statsz)",
+                  file=sys.stderr)
+
+        if args.smoke:
+            problems: List[str] = []
+            if server is not None:
+                res = _run_smoke_wire(
+                    server, args.smoke, args.smoke_streams,
+                    seed=cfg.device.seed,
+                    deadline_ms=args.http_deadline_ms)
+                # read the window BEFORE the drain: the final stats emit
+                # in stop() resets it
+                snap = service.meter.snapshot(time.perf_counter(),
+                                              reset=False)
+                # the lifecycle assertions ride the smoke: readiness
+                # flips to 503 the moment the drain begins, liveness
+                # stays 200, and the drain completes cleanly
+                problems = _assert_drain_transition(server)
+                if not server.drain(grace_s=0.0, timeout_s=60.0):
+                    problems.append("drain timed out with requests "
+                                    "still in flight")
+            else:
+                res = _run_smoke_inproc(service, args.smoke,
+                                        args.smoke_streams,
+                                        seed=cfg.device.seed)
+                # read the window BEFORE stop(), same reason
                 snap = service.meter.snapshot(time.perf_counter(),
                                               reset=False)
                 service.stop()
-                _export_trace()
-                print(serve_log_line(snap))
-                if done != args.smoke:
-                    print(f"serve: smoke completed {done}/{args.smoke} "
-                          "requests", file=sys.stderr)
-                    return 1
-                events.emit("run_end", smoke_requests=done,
-                            compile_count=service.engine.compile_count)
-                return 0
-            # long-running mode: the worker serves; this thread naps and
-            # flushes stats windows until SIGINT
-            while True:
-                time.sleep(serve_cfg.stats_interval_s)
+            _export_trace()
+            print(serve_log_line(snap))
+            print(res.summary(), file=sys.stderr)
+            for p in problems:
+                print(f"serve: smoke lifecycle violation: {p}",
+                      file=sys.stderr)
+            events.emit("run_end", smoke_requests=res.completed,
+                        smoke_failed=res.failed,
+                        compile_count=service.engine.compile_count)
+            return 1 if problems else _smoke_rc(res, args.smoke)
+
+        # long-running mode: the worker serves; this thread naps and
+        # flushes stats windows until SIGTERM/SIGINT starts the drain
+        stop_signal = threading.Event()
+        sig_name = {}
+
+        def _on_signal(signum, frame):  # noqa: ARG001 — handler contract
+            sig_name["got"] = signal.Signals(signum).name
+            stop_signal.set()
+
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+        try:
+            while not stop_signal.wait(serve_cfg.stats_interval_s):
                 service._emit_stats(force=True)
-        except KeyboardInterrupt:
-            print("serve: SIGINT — draining")
-            return 0
         finally:
-            if args.smoke == 0:
+            print(f"serve: {sig_name.get('got', 'shutdown')} — draining "
+                  f"(readyz 503 for {args.drain_grace_s}s, then "
+                  "completing in-flight requests)", file=sys.stderr)
+            if server is not None:
+                server.drain(grace_s=args.drain_grace_s)
+            else:
                 service.stop()
-                _export_trace()
-                events.emit("run_end",
-                            compile_count=service.engine.compile_count)
+            _export_trace()
+            events.emit("run_end",
+                        compile_count=service.engine.compile_count)
+            print("serve: drained — every accepted request resolved",
+                  file=sys.stderr)
     return 0
 
 

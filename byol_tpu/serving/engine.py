@@ -22,20 +22,48 @@ is ahead-of-time all the way down:
   (probed at construction — CPU backends expose only ``unpinned_host``
   and take the direct path).
 
-Threading contract: :meth:`embed` is called by ONE thread (the service
-worker) — the staging buffers are reused across calls and must never be
-written concurrently.  Construction/warmup happen before the worker starts.
+The hot path is split for the pipelined worker (ISSUE 13): :meth:`dispatch`
+stages a batch and launches its executable (JAX dispatch is asynchronous —
+the call returns while the device works), :meth:`readback` blocks on the
+D2H; :meth:`embed` is the two back-to-back.  With two batches alive at
+once, staging the NEXT batch overlaps the device computing the CURRENT
+one — H2D/compute/D2H pipelining across consecutive batches, the serving
+analog of data/prefetch.py.  Each bucket keeps TWO alternating host
+staging buffers sized to the pipeline depth: writing batch ``i+1``'s rows
+into the buffer batch ``i`` staged from would race an asynchronous
+transfer/execution that may not have consumed it yet.
+
+Threading contract: :meth:`dispatch`/:meth:`readback`/:meth:`embed` are
+called by ONE thread (the service worker) — the staging buffers are
+reused across calls and must never be written concurrently.
+Construction/warmup happen before the worker starts.
 """
 from __future__ import annotations
 
+import dataclasses
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from byol_tpu.observability import spans as spans_lib
 from byol_tpu.serving.buckets import BucketSpec
+
+# staging buffers per bucket: one being consumed by an in-flight batch,
+# one free to write — matches the worker's pipeline depth of 2 (at most
+# two batches alive between dispatch and readback)
+_STAGING_SLOTS = 2
+
+
+@dataclasses.dataclass
+class InFlightBatch:
+    """A dispatched-but-not-read-back batch: the device handle plus the
+    slicing metadata readback needs to undo the bucket padding."""
+
+    out: Any                 # the executable's (bucket, D) device array
+    rows: int                # real rows in the batch
+    bucket: int              # padded bucket the executable ran at
 
 
 class ServingEngine:
@@ -59,7 +87,8 @@ class ServingEngine:
         self.input_dtype = np.dtype(input_dtype)
         self.buckets = buckets
         self._executables: Dict[int, Any] = {}
-        self._staging: Dict[int, np.ndarray] = {}
+        self._staging: Dict[int, List[np.ndarray]] = {}
+        self._staging_flip: Dict[int, int] = {}
         self.compile_count = 0
         self.compile_seconds: Dict[int, float] = {}
         # flight recorder (observability/spans.py): stage/dispatch/
@@ -93,15 +122,24 @@ class ServingEngine:
     def _stage(self, rows: np.ndarray, bucket: int):
         """rows -> device-resident padded batch in the plan's layout.
 
-        One reusable host buffer per bucket (no per-request allocation),
+        Reusable host buffers per bucket (no per-request allocation),
         zeroed pad tail (stale rows from the previous batch must never
         alias into this one), one transfer — through pinned-host pages
-        when the backend has them.
+        when the backend has them.  Buffers ALTERNATE (two slots per
+        bucket): under the pipelined worker the previous batch's buffer
+        may still back an in-flight asynchronous transfer — overwriting
+        it would corrupt the batch the device is about to read.
         """
-        buf = self._staging.get(bucket)
-        if buf is None:
-            buf = np.zeros((bucket,) + self.input_shape, self.input_dtype)
-            self._staging[bucket] = buf
+        bufs = self._staging.get(bucket)
+        if bufs is None:
+            bufs = [np.zeros((bucket,) + self.input_shape,
+                             self.input_dtype)
+                    for _ in range(_STAGING_SLOTS)]
+            self._staging[bucket] = bufs
+            self._staging_flip[bucket] = 0
+        flip = self._staging_flip[bucket]
+        self._staging_flip[bucket] = (flip + 1) % _STAGING_SLOTS
+        buf = bufs[flip]
         n = rows.shape[0]
         buf[:n] = rows
         if n < bucket:
@@ -133,21 +171,20 @@ class ServingEngine:
                 self._compile(b)
 
     # ---- the hot path -----------------------------------------------------
-    def embed(self, rows: np.ndarray,
-              timeline: Optional[Dict[str, float]] = None) -> np.ndarray:
-        """``(n, H, W, C)`` request rows -> ``(n, D)`` fp32 embeddings.
-
-        Pads to the row count's bucket, runs that bucket's executable
-        (compiling it first only if warmup never touched it), and slices
-        the pad rows back off.  The readback blocks — the worker's batch
-        cadence IS the serving cadence, there is nothing to run ahead to.
+    def dispatch(self, rows: np.ndarray,
+                 timeline: Optional[Dict[str, float]] = None
+                 ) -> InFlightBatch:
+        """Stage ``(n, H, W, C)`` rows and LAUNCH the bucket executable;
+        returns the in-flight handle without blocking on the result (JAX
+        dispatch is asynchronous — the device works while the host goes
+        back for the next batch).  Compiles the bucket first only if
+        warmup never touched it.
 
         ``timeline``, when given, receives the batch-level lifecycle
-        stamps (perf_counter absolutes): ``stage`` after the H2D transfer,
-        ``dispatch`` after the executable call returns, ``readback`` after
-        the D2H completes — the service copies them onto every request in
-        the batch (batcher.LIFECYCLE_PHASES).
-        """
+        stamps (perf_counter absolutes): ``stage`` after the H2D launch,
+        ``dispatch`` after the executable call returns — the service
+        copies them onto every request in the batch
+        (batcher.LIFECYCLE_PHASES)."""
         n = rows.shape[0]
         bucket = self.buckets.bucket_for(n)
         exe = self._executables.get(bucket)
@@ -161,16 +198,32 @@ class ServingEngine:
             out = exe(staged)
         if timeline is not None:
             timeline["dispatch"] = time.perf_counter()
+        return InFlightBatch(out=out, rows=n, bucket=bucket)
+
+    def readback(self, inflight: InFlightBatch,
+                 timeline: Optional[Dict[str, float]] = None
+                 ) -> np.ndarray:
+        """Block on one in-flight batch's D2H and undo the bucket padding
+        -> ``(n, D)`` fp32 embeddings.  ``timeline`` gets the ``readback``
+        stamp."""
+        n, bucket = inflight.rows, inflight.bucket
         # EXPLICIT readback (device_get, not np.asarray): the embed path
         # runs clean under jax.transfer_guard("disallow") — any IMPLICIT
         # transfer in here is a bug the guard_steps test would catch.
         with self._recorder.span("serve/readback", bucket=bucket):
-            host = jax.device_get(out)
+            host = jax.device_get(inflight.out)
         if timeline is not None:
             timeline["readback"] = time.perf_counter()
         # copy when padded: a [:n] VIEW would pin the full (bucket, D)
         # buffer for as long as any caller holds the result
         return host[:n] if n == bucket else host[:n].copy()
+
+    def embed(self, rows: np.ndarray,
+              timeline: Optional[Dict[str, float]] = None) -> np.ndarray:
+        """``(n, H, W, C)`` request rows -> ``(n, D)`` fp32 embeddings:
+        dispatch + immediate readback (the unpipelined path and the
+        direct-call API the parity tests use)."""
+        return self.readback(self.dispatch(rows, timeline), timeline)
 
     def describe(self) -> Dict[str, Any]:
         """Provenance for the serve run header / bench rows."""

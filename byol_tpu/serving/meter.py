@@ -57,9 +57,16 @@ class ServingMeter:
         # BREAKDOWN behind the p50/p99 headline
         self._phase_s: Dict[str, float] = {}
         self._phase_requests = 0
+        # wire-layer window (serving/net/server.py): HTTP answer counts
+        # by status and read/parse/wait/write phase sums — the front-door
+        # breakdown serve_stats carries as the additive ``wire`` field
+        self._wire_status: Dict[str, int] = {}
+        self._wire_phase_s: Dict[str, float] = {}
+        self._wire_requests = 0
         # lifetime totals (never reset): the run_end summary
         self.total_requests = 0
         self.total_batches = 0
+        self.total_wire_requests = 0
 
     # ---- producer side (client threads) -----------------------------------
     def record_enqueue(self, queue_depth: int) -> None:
@@ -91,6 +98,21 @@ class ServingMeter:
                 self._phase_s[phase] = (self._phase_s.get(phase, 0.0)
                                         + float(seconds))
             self._phase_requests += 1
+
+    # ---- wire side (the HTTP front end's handler threads) ------------------
+    def record_wire(self, status: int, phases: Dict[str, float]) -> None:
+        """Account one HTTP answer: final status + the wire phase
+        durations (server.WIRE_PHASES deltas) it reached.  EVERY answer
+        counts — a window full of 4xx is exactly the window worth
+        seeing, and the status histogram is how serve_stats says so."""
+        with self._lock:
+            key = str(int(status))
+            self._wire_status[key] = self._wire_status.get(key, 0) + 1
+            for phase, seconds in phases.items():
+                self._wire_phase_s[phase] = (
+                    self._wire_phase_s.get(phase, 0.0) + float(seconds))
+            self._wire_requests += 1
+            self.total_wire_requests += 1
 
     # ---- readout ----------------------------------------------------------
     def snapshot(self, t_now: float, *, reset: bool = True
@@ -129,6 +151,19 @@ class ServingMeter:
                 out["phase_ms"] = {
                     k: _ms(v / self._phase_requests)
                     for k, v in sorted(self._phase_s.items())}
+            if self._wire_requests:
+                # additive wire-layer block: HTTP status histogram + mean
+                # read/parse/wait/write durations — the front-door tax on
+                # top of the enqueue->deliver phase_ms above (wait spans
+                # the whole in-process path, so wire p50 ≈ read + parse
+                # + wait + write)
+                out["wire"] = {
+                    "http_requests": float(self._wire_requests),
+                    "status": dict(sorted(self._wire_status.items())),
+                    "phase_ms": {
+                        k: _ms(v / self._wire_requests)
+                        for k, v in sorted(self._wire_phase_s.items())},
+                }
             if reset:
                 self._latencies.clear()
                 self._requests = self._rows = self._batches = 0
@@ -136,6 +171,9 @@ class ServingMeter:
                 self._depth_sum = self._depth_samples = 0
                 self._phase_s = {}
                 self._phase_requests = 0
+                self._wire_status = {}
+                self._wire_phase_s = {}
+                self._wire_requests = 0
                 self._window_start = None
             return out
 

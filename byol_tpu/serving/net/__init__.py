@@ -1,0 +1,25 @@
+"""byol_tpu/serving/net/ — the wire-protocol front end over EmbeddingService.
+
+Four stdlib-only modules (no new dependencies):
+
+- :mod:`~byol_tpu.serving.net.protocol` — the versioned wire format
+  (strict-JSON header + raw tensor payload) and its typed 4xx error map;
+- :mod:`~byol_tpu.serving.net.server` — the ThreadingHTTPServer adapter
+  over ``EmbeddingService.submit`` with deadline-aware admission control
+  and a graceful drain lifecycle;
+- :mod:`~byol_tpu.serving.net.client` — connection-reusing client with
+  timeout + jittered backoff on 429/503;
+- :mod:`~byol_tpu.serving.net.loadgen` — the closed-loop multi-stream
+  request generator shared by ``--smoke`` and ``bench.py --wire-ladder``.
+
+Import discipline mirrors the batcher's: protocol/client/loadgen are
+jax-free host code, and the server imports only the service object it is
+handed — transport choices stay unwelded from the batching/compile
+machinery (the PR 8 scope note, now paid off).
+"""
+from byol_tpu.serving.net.protocol import (PROTOCOL_VERSION, WireError,
+                                           decode_request, decode_response,
+                                           encode_request, encode_response)
+
+__all__ = ["PROTOCOL_VERSION", "WireError", "decode_request",
+           "decode_response", "encode_request", "encode_response"]

@@ -21,6 +21,7 @@ before the first request can arrive.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 import time
@@ -29,7 +30,7 @@ from typing import Any, Optional, Tuple
 import numpy as np
 
 from byol_tpu.observability import spans as spans_lib
-from byol_tpu.serving.batcher import DynamicBatcher, Request
+from byol_tpu.serving.batcher import EMPTY, DynamicBatcher, Request
 from byol_tpu.serving.buckets import BucketSpec
 from byol_tpu.serving.engine import ServingEngine
 from byol_tpu.serving.meter import ServingMeter
@@ -44,19 +45,37 @@ class EmbeddingService:
     readback spans inside it — so one trace id follows a request from
     ``submit`` through the engine to its future, and the exported Chrome
     trace shows the full lifecycle.  Defaults to the no-op NULL recorder.
+
+    ``pipeline`` ("on"/"off", default on): with "on" the worker keeps up
+    to TWO batches alive between dispatch and readback — while the device
+    computes batch *i*, the host coalesces, stages, and dispatches batch
+    *i+1*, so H2D/compute/D2H overlap across consecutive batches (the
+    serving analog of data/prefetch.py; ROADMAP serving item (b)).  The
+    executables, numerics, and delivery ORDER are identical to "off" —
+    batches still complete FIFO — only the host/device overlap changes;
+    tests/test_serving.py pins bitwise parity between the two modes.
     """
 
     def __init__(self, engine: ServingEngine, batcher: DynamicBatcher,
                  *, meter: Optional[ServingMeter] = None,
                  events: Optional[Any] = None,
                  stats_interval_s: float = 10.0,
-                 recorder: Any = None) -> None:
+                 recorder: Any = None,
+                 pipeline: str = "on") -> None:
+        if pipeline not in ("off", "on"):
+            raise ValueError(
+                f"pipeline must be 'off' or 'on', got {pipeline!r}")
         self.engine = engine
         self.batcher = batcher
         self.meter = meter if meter is not None else ServingMeter()
         self.events = events
         self.recorder = recorder if recorder is not None else spans_lib.NULL
         self.stats_interval_s = stats_interval_s
+        self.pipeline = pipeline
+        # max batches alive between dispatch and readback: 2 = classic
+        # double buffering (one computing, one being staged/dispatched);
+        # 1 = the pre-pipelining readback-before-next-batch behavior
+        self._max_inflight = 2 if pipeline == "on" else 1
         self._thread: Optional[threading.Thread] = None
         self._last_stats = time.perf_counter()
         # serializes stats emits: the worker (per batch) and the CLI's
@@ -104,10 +123,13 @@ class EmbeddingService:
 
     # ---- client API -------------------------------------------------------
     def submit(self, images: np.ndarray,
-               timeout: Optional[float] = 1.0) -> Request:
+               timeout: Optional[float] = 1.0,
+               trace_id=None) -> Request:
         """Enqueue ``(rows, H, W, C)`` images; returns the future.  Blocks
         up to ``timeout`` when the bounded queue is full, then raises
-        :class:`~byol_tpu.serving.batcher.Backpressure`.
+        :class:`~byol_tpu.serving.batcher.Backpressure`.  ``trace_id``
+        overrides the generated correlation key (the wire front end
+        passes its X-Request-Id).
 
         The per-row shape is validated against the engine's input contract
         HERE, in the client's thread: a wrong-sized image must be that
@@ -119,7 +141,8 @@ class EmbeddingService:
             raise ValueError(
                 f"request rows of shape {tuple(row_shape)} do not match "
                 f"the served model's input {self.engine.input_shape}")
-        req = self.batcher.submit(images, timeout=timeout)
+        req = self.batcher.submit(images, timeout=timeout,
+                                  trace_id=trace_id)
         self.meter.record_enqueue(self.batcher.depth())
         return req
 
@@ -130,10 +153,23 @@ class EmbeddingService:
 
     # ---- worker -----------------------------------------------------------
     def _run(self) -> None:
+        # in-flight pipeline, FIFO: each entry is a dispatched batch
+        # whose readback has not happened yet.  Depth 1 reproduces the
+        # pre-pipelining worker exactly (dispatch -> immediate readback);
+        # depth 2 overlaps the host's coalesce+stage+dispatch of the next
+        # batch with the device computing the current one.
+        pending: "collections.deque" = collections.deque()
         while True:
-            batch = self.batcher.next_batch()
-            if batch is None:
-                return
+            # block only when nothing is in flight: with a batch pending,
+            # an idle queue means "read back now", never "wait" — a
+            # closed-loop client waiting on the pending batch will not
+            # submit again until it is delivered (blocking would deadlock)
+            batch = self.batcher.next_batch(block=not pending)
+            if batch is None:           # closed AND drained
+                break
+            if batch is EMPTY:          # open, no traffic right now
+                self._complete(*pending.popleft())
+                continue
             timeline: dict = {}
             try:
                 # assembly INSIDE the relay: any per-batch failure —
@@ -141,43 +177,62 @@ class EmbeddingService:
                 # foresee — belongs to this batch's futures, never to
                 # the worker thread (whose death would strand the queue).
                 # The serve/batch span carries the members' trace ids;
-                # the engine's stage/dispatch/readback spans nest inside.
+                # the engine's stage/dispatch spans nest inside (the
+                # readback span lands at completion time).
                 with self.recorder.span(
                         "serve/batch",
                         trace_ids=[r.trace_id for r in batch]):
                     rows = (batch[0].images if len(batch) == 1 else
                             np.concatenate([r.images for r in batch],
                                            axis=0))
-                    embeddings = self.engine.embed(rows, timeline=timeline)
+                    inflight = self.engine.dispatch(rows,
+                                                    timeline=timeline)
             except Exception as e:  # noqa: BLE001 — relayed per request
                 for r in batch:
                     r.set_error(e)
                 continue
-            t_now = time.perf_counter()
-            self.meter.record_batch(
-                rows.shape[0], self.engine.buckets.bucket_for(rows.shape[0]),
-                t_now)
-            lo = 0
+            pending.append((batch, inflight, timeline))
+            # at the depth cap, read back the oldest: with depth 2 this
+            # blocks on batch i's D2H while batch i+1 computes; depth 1
+            # completes immediately (the sequential pre-pipeline order)
+            while len(pending) >= self._max_inflight:
+                self._complete(*pending.popleft())
+        while pending:                  # drain: every dispatched batch
+            self._complete(*pending.popleft())   # still delivers
+
+    def _complete(self, batch, inflight, timeline: dict) -> None:
+        """Read back one in-flight batch and resolve its futures —
+        delivery order is dispatch order (FIFO deque), so pipelining
+        never reorders results."""
+        try:
+            embeddings = self.engine.readback(inflight, timeline=timeline)
+        except Exception as e:  # noqa: BLE001 — relayed per request
             for r in batch:
-                # lifecycle completion BEFORE set_result (same barrier
-                # contract as the latency sample below): a client waking
-                # from result() must find its request's full
-                # enqueue -> deliver chain stamped and already counted
-                r.marks.update(timeline)
-                r.mark("deliver", t_now)
-                # latency recorded BEFORE set_result: a client returning
-                # from result() (e.g. the bench rung joining its streams
-                # and snapshotting the meter) must find its own sample
-                # already counted — recording after would race the reader
-                self.meter.record_latency(r.latency(t_now))
-                self.meter.record_lifecycle(r.lifecycle())
-                # per-request COPY, not a view: a client holding one
-                # request's rows must not pin the whole batch's buffer
-                # for its lifetime
-                sl = embeddings[lo:lo + r.rows]
-                r.set_result(sl if len(batch) == 1 else sl.copy())
-                lo += r.rows
-            self._emit_stats()
+                r.set_error(e)
+            return
+        t_now = time.perf_counter()
+        self.meter.record_batch(inflight.rows, inflight.bucket, t_now)
+        lo = 0
+        for r in batch:
+            # lifecycle completion BEFORE set_result (same barrier
+            # contract as the latency sample below): a client waking
+            # from result() must find its request's full
+            # enqueue -> deliver chain stamped and already counted
+            r.marks.update(timeline)
+            r.mark("deliver", t_now)
+            # latency recorded BEFORE set_result: a client returning
+            # from result() (e.g. the bench rung joining its streams
+            # and snapshotting the meter) must find its own sample
+            # already counted — recording after would race the reader
+            self.meter.record_latency(r.latency(t_now))
+            self.meter.record_lifecycle(r.lifecycle())
+            # per-request COPY, not a view: a client holding one
+            # request's rows must not pin the whole batch's buffer
+            # for its lifetime
+            sl = embeddings[lo:lo + r.rows]
+            r.set_result(sl if len(batch) == 1 else sl.copy())
+            lo += r.rows
+        self._emit_stats()
 
     def _emit_stats(self, force: bool = False) -> None:
         with self._stats_lock:
@@ -204,6 +259,7 @@ class ServeConfig:
     max_wait_ms: float = 5.0
     num_classes: int = 10        # probe-head width the checkpoint trained
     stats_interval_s: float = 10.0
+    pipeline: str = "on"         # worker dispatch pipelining (off|on)
 
 
 def _abstract_canonical_state(rcfg, net, plan):
@@ -346,4 +402,5 @@ def build_service(cfg, serve_cfg: ServeConfig, *,
                              max_wait_s=serve_cfg.max_wait_ms / 1e3)
     return EmbeddingService(engine, batcher, events=events,
                             stats_interval_s=serve_cfg.stats_interval_s,
-                            recorder=recorder)
+                            recorder=recorder,
+                            pipeline=serve_cfg.pipeline)

@@ -1,0 +1,14 @@
+"""GL110 must fire: lenient json writers that can emit bare NaN tokens."""
+import json
+
+
+def write_metrics(path, metrics):
+    # BAD: no allow_nan kwarg — the lenient default serializes a NaN
+    # loss as the bare token `NaN`, which strict parsers reject
+    with open(path, "w") as f:
+        json.dump(metrics, f)
+
+
+def render_line(metrics):
+    # BAD: explicitly lenient
+    return json.dumps(metrics, allow_nan=True)

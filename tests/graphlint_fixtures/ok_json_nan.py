@@ -1,0 +1,36 @@
+"""GL110 near-misses that must stay clean."""
+import json
+import math
+
+
+def _sanitize(obj):
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return "NaN"
+    return obj
+
+
+def write_metrics(path, metrics):
+    # OK: strict writer (the events.py discipline)
+    with open(path, "w") as f:
+        json.dump({k: _sanitize(v) for k, v in metrics.items()}, f,
+                  allow_nan=False)
+
+
+def render_line(metrics):
+    # OK: strict
+    return json.dumps(metrics, allow_nan=False)
+
+
+def forward(metrics, **kwargs):
+    # OK: a **kwargs splat may carry allow_nan invisibly — stand down
+    return json.dumps(metrics, **kwargs)
+
+
+def computed(metrics, strict):
+    # OK: non-literal allow_nan cannot be judged statically
+    return json.dumps(metrics, allow_nan=not strict)
+
+
+def loads_is_not_a_writer(line):
+    # OK: the reader has no NaN-emission hazard
+    return json.loads(line)

@@ -371,3 +371,33 @@ def test_saver_state_survives_restart(tmp_path):
     assert not saver2(0.9, 2, state)  # stall 2
     assert saver2(0.9, 3, state)      # stall 3 -> stop
     saver2.close()
+
+
+def test_meta_nonfinite_metric_roundtrips_strict_json(tmp_path):
+    """GL110 (ISSUE 13 satellite): a NaN eval metric must neither crash
+    the meta.json write (allow_nan=False would raise on a bare float)
+    nor land as a bare NaN token — it writes as the events.py string
+    convention and reads back as the float it was."""
+    import json
+    import math
+
+    store = CheckpointStore(str(tmp_path / "nan"))
+    store.write_meta({"last_epoch": 3,
+                      "history": [{"epoch": 3, "metric": float("nan")}],
+                      "best_metric": float("-inf"),
+                      # sanitize is not injective: a user STRING that
+                      # merely spells the sentinel must survive the
+                      # round trip verbatim (restore is scoped to the
+                      # numeric keys this module writes)
+                      "note": "NaN"})
+    raw = open(str(tmp_path / "nan" / "meta.json")).read()
+    # strict parse: parse_constant fires only on bare non-finite tokens
+    parsed = json.loads(raw, parse_constant=lambda tok: (_ for _ in ())
+                        .throw(AssertionError(f"bare {tok} token")))
+    assert parsed["history"][0]["metric"] == "NaN"
+    meta = store.read_meta()
+    assert math.isnan(meta["history"][0]["metric"])
+    assert meta["best_metric"] == float("-inf")
+    assert meta["last_epoch"] == 3
+    assert meta["note"] == "NaN"          # still a string
+    store.close()

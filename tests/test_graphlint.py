@@ -44,6 +44,9 @@ RULE_CASES = [
     ("GL107", "bad_sharding_axes.py", "ok_sharding_axes.py"),
     ("GL108", "bad_collective_vmap.py", "ok_collective_vmap.py"),
     ("GL109", "bad_pallas_interpret.py", "ok_pallas_interpret.py"),
+    # lenient json writers emit bare NaN tokens strict parsers reject —
+    # the PR 6 run-log lesson as a rule (ISSUE 13 satellite)
+    ("GL110", "bad_json_nan.py", "ok_json_nan.py"),
 ]
 
 

@@ -1,0 +1,621 @@
+"""byol_tpu/serving/net/ — the wire front end (ISSUE 13 tentpole).
+
+Layers, cheapest first:
+
+1. **Protocol**: frame round-trips for both wire dtypes; every class of
+   malformed request (bad framing, bad JSON, wrong version, wrong dtype,
+   wrong shape, truncated/trailing payload, too many rows) maps to its
+   typed 4xx — and decode can never produce a half-valid tensor.
+2. **Server robustness** (stub engine, jax-free): each mapped 4xx comes
+   back over a REAL socket with the server still serving afterwards; the
+   deadline budget propagates (expired -> 408, saturation -> 429 with
+   Retry-After, both within the budget — bounded and prompt, no hang).
+3. **Lifecycle**: /healthz stays 200 while /readyz flips to 503 the
+   moment a drain begins; a drain racing live client threads completes
+   every accepted request and strands nothing (the SIGTERM hammer).
+4. **Loadgen/smoke accounting** (ISSUE 13 satellite): failures are
+   counted, surfaced, and turn the smoke exit code nonzero.
+5. **Wire parity** (real engine on the CPU mesh): embeddings fetched
+   over HTTP are bitwise equal to ``linear_eval.extract_features`` for
+   exact-fill and padded buckets — the acceptance pin.
+"""
+import json
+import struct
+import threading
+import time
+import types
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import jax
+
+from byol_tpu.serving.batcher import DynamicBatcher
+from byol_tpu.serving.net import protocol
+from byol_tpu.serving.net.client import (EmbedClient, WireClientError,
+                                         parse_address)
+from byol_tpu.serving.net.loadgen import run_closed_loop
+from byol_tpu.serving.net.server import WireServer
+from byol_tpu.serving.service import EmbeddingService
+from tests.test_serving import _NUM_CLASSES, _StubEngine, _serve_cfg
+
+
+# ---------------------------------------------------------------------------
+# 1. protocol (no sockets, no jax)
+# ---------------------------------------------------------------------------
+
+_SHAPE = (4, 4, 3)
+
+
+def _frame_bytes(header: dict, payload: bytes) -> bytes:
+    head = json.dumps(header).encode()
+    return struct.pack(">I", len(head)) + head + payload
+
+
+class TestProtocol:
+    def test_float32_roundtrip_is_exact(self):
+        rng = np.random.RandomState(0)
+        images = rng.rand(5, *_SHAPE).astype(np.float32)
+        body = protocol.encode_request(images)
+        decoded = protocol.decode_request(body, input_shape=_SHAPE,
+                                          max_rows=16)
+        np.testing.assert_array_equal(decoded, images)
+        assert decoded.dtype == np.float32
+
+    def test_uint8_conversion_rule_is_deterministic(self):
+        """uint8 on the wire -> float32 x/255 on the host, the ONE
+        documented rule — a uint8 client and a float32 client sending
+        the converted array must produce identical model inputs."""
+        rng = np.random.RandomState(1)
+        u8 = rng.randint(0, 256, size=(3, *_SHAPE), dtype=np.uint8)
+        decoded = protocol.decode_request(
+            protocol.encode_request(u8), input_shape=_SHAPE, max_rows=16)
+        expected = u8.astype(np.float32) / np.float32(255.0)
+        np.testing.assert_array_equal(decoded, expected)
+        # and the uint8 frame is ~4x smaller than the float32 one
+        assert len(protocol.encode_request(u8)) < len(
+            protocol.encode_request(expected)) / 2
+
+    def test_single_image_lifted_to_one_row(self):
+        img = np.zeros(_SHAPE, np.float32)
+        decoded = protocol.decode_request(
+            protocol.encode_request(img), input_shape=_SHAPE, max_rows=16)
+        assert decoded.shape == (1,) + _SHAPE
+
+    def test_response_roundtrip(self):
+        emb = np.arange(8, dtype=np.float32).reshape(2, 4)
+        out = protocol.decode_response(protocol.encode_response(emb))
+        np.testing.assert_array_equal(out, emb)
+
+    def test_encode_refuses_other_dtypes(self):
+        with pytest.raises(ValueError, match="uint8 or float32"):
+            protocol.encode_request(np.zeros((1, *_SHAPE), np.float64))
+
+    @pytest.mark.parametrize("body,status,code", [
+        (b"", 400, "bad_frame"),                      # shorter than prefix
+        (b"\x00\x00\x00\x05ab", 400, "bad_frame"),    # ends inside header
+        (struct.pack(">I", protocol.MAX_HEADER_BYTES + 1) + b"x",
+         400, "bad_frame"),                           # header over the cap
+        (_frame_bytes({"v": 99, "dtype": "uint8", "shape": [1, 4, 4, 3]},
+                      bytes(48)), 400, "bad_version"),
+        (struct.pack(">I", 7) + b"notjson", 400, "bad_header"),
+        (_frame_bytes({"v": 1, "dtype": "float64",
+                       "shape": [1, 4, 4, 3]}, bytes(8 * 48)),
+         415, "unsupported_dtype"),
+        (_frame_bytes({"v": 1, "dtype": "uint8", "shape": [1, 4, 4]},
+                      bytes(16)), 400, "bad_shape"),  # ndim mismatch
+        (_frame_bytes({"v": 1, "dtype": "uint8", "shape": [1, 9, 9, 3]},
+                      bytes(243)), 400, "bad_shape"), # row-shape mismatch
+        (_frame_bytes({"v": 1, "dtype": "uint8", "shape": [1, 4, 4, 3]},
+                      bytes(10)), 400, "payload_size_mismatch"),  # short
+        (_frame_bytes({"v": 1, "dtype": "uint8", "shape": [1, 4, 4, 3]},
+                      bytes(99)), 400, "payload_size_mismatch"),  # long
+        (_frame_bytes({"v": 1, "dtype": "uint8", "shape": [17, 4, 4, 3]},
+                      bytes(17 * 48)), 413, "too_many_rows"),
+    ])
+    def test_malformed_requests_map_to_typed_4xx(self, body, status, code):
+        with pytest.raises(protocol.WireError) as e:
+            protocol.decode_request(body, input_shape=_SHAPE, max_rows=16)
+        assert e.value.status == status and e.value.code == code
+
+    def test_max_request_bytes_bounds_the_largest_legal_payload(self):
+        cap = protocol.max_request_bytes(_SHAPE, max_rows=16)
+        biggest = protocol.encode_request(
+            np.zeros((16, *_SHAPE), np.float32))
+        assert len(biggest) <= cap
+
+    def test_parse_address(self):
+        assert parse_address("127.0.0.1:8700") == ("127.0.0.1", 8700)
+        with pytest.raises(ValueError, match="HOST:PORT"):
+            parse_address("8700")
+        with pytest.raises(ValueError, match="not an integer"):
+            parse_address("host:80x0")
+
+
+# ---------------------------------------------------------------------------
+# 2 + 3. server over a real socket (stub engine — jax-free service)
+# ---------------------------------------------------------------------------
+
+def _stub_service(**kw) -> EmbeddingService:
+    engine = _StubEngine(**kw.pop("engine_kw", {}))
+    svc = EmbeddingService(
+        engine,
+        DynamicBatcher(max_batch=kw.pop("max_batch", 16),
+                       max_queue=kw.pop("max_queue", 64),
+                       max_wait_s=kw.pop("max_wait_s", 0.002)),
+        **kw)
+    svc.start(warmup=False)
+    return svc
+
+
+def _raw_post(host, port, body, headers=None, timeout=10.0):
+    import http.client
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/embed", body=body,
+                     headers={"Content-Type": "application/octet-stream",
+                              **(headers or {})})
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+@pytest.fixture()
+def stub_server():
+    svc = _stub_service()
+    server = WireServer(svc, "127.0.0.1", 0,
+                        default_deadline_ms=10_000.0).start()
+    yield server
+    server.drain(grace_s=0.0, timeout_s=30.0)
+
+
+def _good_body(rows=1):
+    return protocol.encode_request(
+        np.arange(rows * 48, dtype=np.float32).reshape(rows, *_SHAPE))
+
+
+class TestServerRobustness:
+    def test_embed_roundtrip_and_request_id_echo(self, stub_server):
+        host, port = stub_server.address
+        status, payload, headers = _raw_post(
+            host, port, _good_body(), {"X-Request-Id": "req-abc"})
+        assert status == 200
+        assert headers.get("X-Request-Id") == "req-abc"
+        out = protocol.decode_response(payload)
+        # the stub echoes the first 4 features of each row
+        np.testing.assert_array_equal(out, [[0.0, 1.0, 2.0, 3.0]])
+
+    @pytest.mark.parametrize("body,status,code", [
+        (b"garbage", 400, "bad_frame"),
+        (_frame_bytes({"v": 1, "dtype": "float64",
+                       "shape": [1, 4, 4, 3]}, bytes(8 * 48)),
+         415, "unsupported_dtype"),
+        (_frame_bytes({"v": 1, "dtype": "uint8", "shape": [1, 4, 4, 3]},
+                      bytes(10)), 400, "payload_size_mismatch"),
+        (_frame_bytes({"v": 1, "dtype": "uint8", "shape": [17, 4, 4, 3]},
+                      bytes(17 * 48)), 413, "too_many_rows"),
+    ])
+    def test_each_4xx_leaves_the_server_serving(self, stub_server, body,
+                                                status, code):
+        """The acceptance pin: a malformed/oversized/wrong-dtype request
+        is THAT client's mapped 4xx, and the very next good request on a
+        fresh connection succeeds — parse errors can never kill the
+        server or poison the worker."""
+        host, port = stub_server.address
+        got_status, payload, _ = _raw_post(host, port, body)
+        assert got_status == status
+        err = json.loads(payload)
+        assert err["error"] == code
+        ok_status, ok_payload, _ = _raw_post(host, port, _good_body())
+        assert ok_status == 200
+        assert protocol.decode_response(ok_payload).shape == (1, 4)
+
+    def test_oversized_content_length_refused_before_read(self,
+                                                          stub_server):
+        host, port = stub_server.address
+        status, payload, _ = _raw_post(
+            host, port, b"",
+            {"Content-Length": str(stub_server.max_body_bytes + 1)})
+        assert status == 413
+        assert json.loads(payload)["error"] == "too_large"
+        # server healthy afterwards (new connection — the oversized one
+        # was deliberately closed)
+        assert _raw_post(host, port, _good_body())[0] == 200
+
+    def test_missing_content_length_is_411(self, stub_server):
+        import http.client
+        host, port = stub_server.address
+        conn = http.client.HTTPConnection(host, port, timeout=10.0)
+        try:
+            # bypass http.client's automatic Content-Length
+            conn.putrequest("POST", "/v1/embed", skip_host=False)
+            conn.putheader("Transfer-Encoding", "chunked")
+            conn.endheaders()
+            conn.send(b"0\r\n\r\n")
+            resp = conn.getresponse()
+            assert resp.status == 411
+        finally:
+            conn.close()
+
+    def test_expired_deadline_is_408(self, stub_server):
+        host, port = stub_server.address
+        status, payload, _ = _raw_post(host, port, _good_body(),
+                                       {"X-Deadline-Ms": "0"})
+        assert status == 408
+        assert json.loads(payload)["error"] == "deadline_expired"
+        assert _raw_post(host, port, _good_body())[0] == 200
+
+    def test_invalid_deadline_is_400(self, stub_server):
+        host, port = stub_server.address
+        for bad in ("abc", "NaN", "inf", "-inf", "-Infinity"):
+            status, payload, _ = _raw_post(host, port, _good_body(),
+                                           {"X-Deadline-Ms": bad})
+            assert status == 400, bad
+            assert json.loads(payload)["error"] == "bad_deadline"
+
+    def test_health_ready_stats_endpoints(self, stub_server):
+        host, port = stub_server.address
+        with EmbedClient(host, port, timeout_s=10.0) as c:
+            assert c.get("/healthz")[0] == 200
+            assert c.get("/readyz")[0] == 200
+            c.embed(np.zeros((1, *_SHAPE), np.float32))
+            status, body = c.get("/statsz")
+            assert status == 200
+            stats = json.loads(body)
+            assert stats["draining"] is False
+            assert stats["serve_stats"]["requests"] >= 1.0
+            # the wire-phase block reached the stats surface
+            assert stats["serve_stats"]["wire"]["status"]["200"] >= 1
+            assert c.get("/nope")[0] == 404
+
+    def test_saturated_queue_answers_429_within_budget(self):
+        """Backpressure maps to 429 + Retry-After and comes back INSIDE
+        the deadline budget: a saturated service refuses promptly, it
+        never hangs a client or strands a future."""
+        svc = _stub_service(engine_kw={"dispatch_delay_s": 1.0},
+                            max_queue=1, max_wait_s=0.0)
+        server = WireServer(svc, "127.0.0.1", 0,
+                            default_deadline_ms=10_000.0).start()
+        host, port = server.address
+        deadline_ms = 400.0
+        results = []
+        lock = threading.Lock()
+
+        def one(idx):
+            t0 = time.perf_counter()
+            with EmbedClient(host, port, timeout_s=10.0,
+                             max_attempts=1) as c:
+                try:
+                    c.embed(np.zeros((1, *_SHAPE), np.float32),
+                            deadline_ms=deadline_ms)
+                    status = 200
+                except WireClientError as e:
+                    status = e.status
+            with lock:
+                results.append((status, time.perf_counter() - t0))
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        try:
+            statuses = [s for s, _ in results]
+            assert len(results) == 6
+            # with the engine busy 1s/batch and one queue slot, most of a
+            # 6-way burst must be REFUSED (429) or expire waiting (408) —
+            # and every answer lands well inside budget + slop, far
+            # before the 1s compute would
+            assert 429 in statuses, statuses
+            assert all(s in (200, 408, 429) for s in statuses), statuses
+            assert all(el < deadline_ms / 1e3 + 2.0
+                       for _, el in results), results
+            # the Retry-After header rides every 429
+            status, payload, headers = _raw_post(
+                host, port, _good_body(), {"X-Deadline-Ms": "50"})
+            if status == 429:
+                assert "Retry-After" in headers
+        finally:
+            server.drain(grace_s=0.0, timeout_s=30.0)
+
+
+class TestLifecycle:
+    def test_readyz_flips_503_during_drain_healthz_stays_200(self,
+                                                             stub_server):
+        host, port = stub_server.address
+        with EmbedClient(host, port, timeout_s=10.0) as c:
+            assert c.get("/readyz")[0] == 200
+            stub_server.begin_drain()
+            assert c.get("/readyz")[0] == 503
+            # liveness must outlive readiness: the draining process is
+            # healthy, it is just not taking NEW work
+            assert c.get("/healthz")[0] == 200
+            # and a new embed is refused with the draining 503
+            with pytest.raises(WireClientError) as e:
+                with EmbedClient(host, port, timeout_s=10.0,
+                                 max_attempts=1) as c2:
+                    c2.embed(np.zeros((1, *_SHAPE), np.float32))
+            assert e.value.status == 503
+
+    def test_drain_vs_inflight_hammer_strands_nothing(self):
+        """The concurrent SIGTERM-vs-inflight pin: client threads hammer
+        embeds while the main thread drains.  Every answered 200 carries
+        a valid body, every accepted request completes (drain returns
+        clean), refused requests see 503/transport errors — and no
+        thread is left hanging."""
+        svc = _stub_service(engine_kw={"dispatch_delay_s": 0.005},
+                            max_queue=64)
+        server = WireServer(svc, "127.0.0.1", 0,
+                            default_deadline_ms=30_000.0).start()
+        host, port = server.address
+        stats = {"ok": 0, "refused": 0}
+        errors = []
+        lock = threading.Lock()
+
+        def spam(idx):
+            img = np.zeros((1, *_SHAPE), np.float32)
+            with EmbedClient(host, port, timeout_s=15.0,
+                             max_attempts=1, seed=idx) as c:
+                while True:
+                    try:
+                        out = c.embed(img)
+                        if out.shape != (1, 4):
+                            with lock:
+                                errors.append(f"bad shape {out.shape}")
+                            return
+                        with lock:
+                            stats["ok"] += 1
+                    except WireClientError as e:
+                        if e.status in (0, 503):   # drained/closed: done
+                            with lock:
+                                stats["refused"] += 1
+                            return
+                        with lock:
+                            errors.append(str(e))
+                        return
+
+        threads = [threading.Thread(target=spam, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)                  # let traffic build
+        clean = server.drain(grace_s=0.0, timeout_s=30.0)
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not any(t.is_alive() for t in threads)
+        assert clean, "drain timed out with requests in flight"
+        assert not errors, errors
+        assert stats["ok"] > 0           # real traffic was flowing
+        # and the service fully stopped behind the drain
+        from byol_tpu.serving.batcher import ServiceClosed
+        with pytest.raises(ServiceClosed):
+            svc.submit(np.zeros((1, *_SHAPE), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# client backoff against a scripted server
+# ---------------------------------------------------------------------------
+
+class _ScriptedHandler(BaseHTTPRequestHandler):
+    """Answers POSTs from a scripted status list (latched at the end)."""
+
+    script = [200]
+    calls = 0
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        cls = type(self)
+        status = cls.script[min(cls.calls, len(cls.script) - 1)]
+        cls.calls += 1
+        if status == 200:
+            body = protocol.encode_response(
+                np.zeros((1, 4), np.float32))
+            ctype = "application/octet-stream"
+        else:
+            body = json.dumps({"error": "scripted",
+                               "message": "go away"}).encode()
+            ctype = "application/json"
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        if status in (429, 503):
+            self.send_header("Retry-After", "0.01")
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def scripted_server():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _ScriptedHandler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield httpd
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestClientBackoff:
+    def test_retries_429_then_succeeds(self, scripted_server):
+        _ScriptedHandler.script, _ScriptedHandler.calls = \
+            [429, 429, 200], 0
+        host, port = scripted_server.server_address[:2]
+        with EmbedClient(host, port, timeout_s=5.0, max_attempts=5,
+                         backoff_s=0.005, backoff_max_s=0.02,
+                         seed=0) as c:
+            out = c.embed(np.zeros((1, *_SHAPE), np.float32))
+        assert out.shape == (1, 4)
+        assert _ScriptedHandler.calls == 3       # 2 retries, then 200
+
+    def test_gives_up_after_attempt_budget(self, scripted_server):
+        _ScriptedHandler.script, _ScriptedHandler.calls = [503], 0
+        host, port = scripted_server.server_address[:2]
+        with EmbedClient(host, port, timeout_s=5.0, max_attempts=2,
+                         backoff_s=0.005, backoff_max_s=0.02,
+                         seed=0) as c:
+            with pytest.raises(WireClientError) as e:
+                c.embed(np.zeros((1, *_SHAPE), np.float32))
+        assert e.value.status == 503
+        assert _ScriptedHandler.calls == 2
+
+    def test_non_retryable_4xx_raises_immediately(self, scripted_server):
+        _ScriptedHandler.script, _ScriptedHandler.calls = [415], 0
+        host, port = scripted_server.server_address[:2]
+        with EmbedClient(host, port, timeout_s=5.0, max_attempts=5,
+                         seed=0) as c:
+            with pytest.raises(WireClientError) as e:
+                c.embed(np.zeros((1, *_SHAPE), np.float32))
+        assert e.value.status == 415
+        assert _ScriptedHandler.calls == 1       # no retry on client bugs
+
+
+# ---------------------------------------------------------------------------
+# 4. loadgen + smoke exit-code accounting (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+class TestLoadgenAccounting:
+    def test_failures_are_counted_not_swallowed(self):
+        calls = {"n": 0}
+        lock = threading.Lock()
+
+        def embed(idx, img):
+            with lock:
+                calls["n"] += 1
+                n = calls["n"]
+            if n % 3 == 0:
+                raise RuntimeError("boom")
+
+        res = run_closed_loop(embed, _SHAPE, 20, 4, seed=0)
+        assert res.completed + res.failed == 20
+        assert res.failed == 20 // 3
+        assert res.errors and "boom" in res.errors[0]
+        assert not res.ok
+
+    def test_all_success_is_ok(self):
+        res = run_closed_loop(lambda i, img: None, _SHAPE, 12, 3)
+        assert res.completed == 12 and res.failed == 0 and res.ok
+        assert res.percentile_ms(50) >= 0.0
+
+    def test_stream_setup_failure_fails_that_streams_share(self):
+        def setup(idx):
+            raise ConnectionRefusedError("no server")
+
+        res = run_closed_loop(lambda i, img: None, _SHAPE, 8, 2,
+                              stream_setup=setup)
+        assert res.failed == 8 and res.completed == 0
+        assert not res.ok
+
+    def test_smoke_exit_code_pins_failure_nonzero(self):
+        """The ISSUE 13 audit, pinned: a smoke run exits nonzero when ANY
+        request failed or went missing — and zero only on a full sweep of
+        successes."""
+        from byol_tpu.serving.net.loadgen import LoadgenResult
+        from byol_tpu.serving.cli import _smoke_rc
+        assert _smoke_rc(LoadgenResult(requested=8, completed=8,
+                                       failed=0), 8) == 0
+        assert _smoke_rc(LoadgenResult(requested=8, completed=7,
+                                       failed=1), 8) == 1
+        assert _smoke_rc(LoadgenResult(requested=8, completed=7,
+                                       failed=0), 8) == 1   # lost != ok
+
+
+# ---------------------------------------------------------------------------
+# 5. wire parity on the real engine (CPU mesh)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def wire_served(mesh8):
+    """Real encoder on the 8-device CPU mesh behind the full wire stack:
+    protocol -> HTTP -> batcher -> AOT engine."""
+    from byol_tpu.core.config import resolve
+    from byol_tpu.parallel.compile_plan import build_plan
+    from byol_tpu.serving.buckets import BucketSpec
+    from byol_tpu.serving.engine import ServingEngine
+    from byol_tpu.training.build import build_net, init_variables
+    from byol_tpu.training.linear_eval import frozen_representation_fn
+
+    cfg = _serve_cfg()
+    rcfg = resolve(cfg, num_train_samples=64, num_test_samples=16,
+                   output_size=_NUM_CLASSES, input_shape=(16, 16, 3))
+    net = build_net(rcfg)
+    with mesh8:
+        variables = init_variables(net, rcfg, jax.random.PRNGKey(3))
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    represent = frozen_representation_fn(net, params, batch_stats,
+                                         half=False, normalize=False)
+    engine = ServingEngine(represent, build_plan(mesh8),
+                           input_shape=(16, 16, 3),
+                           buckets=BucketSpec(min_bucket=8,
+                                              max_bucket=16))
+    service = EmbeddingService(
+        engine, DynamicBatcher(max_batch=16, max_wait_s=0.005))
+    service.start(warmup=True)
+    server = WireServer(service, "127.0.0.1", 0,
+                        default_deadline_ms=300_000.0).start()
+    yield types.SimpleNamespace(net=net, params=params,
+                                batch_stats=batch_stats,
+                                service=service, server=server)
+    server.drain(grace_s=0.0, timeout_s=60.0)
+
+
+class TestWireParity:
+    def test_wire_embeddings_bitwise_match_linear_eval(self, wire_served):
+        """The acceptance pin: the wire adds framing, HTTP, batching,
+        bucket padding, and pipelined dispatch — and not one bit of
+        difference to the embeddings, for exact-fill AND padded
+        buckets."""
+        from tests.test_serving import _extractor_features
+        rng = np.random.RandomState(11)
+        images = rng.rand(16, 16, 16, 3).astype(np.float32)
+        expected = _extractor_features(wire_served, images)
+        host, port = wire_served.server.address
+        with EmbedClient(host, port, timeout_s=300.0) as c:
+            got_full = c.embed(images)            # exact fill: bucket 16
+            got_padded = c.embed(images[:11])     # padded: bucket 16
+            got_small = c.embed(images[:3])       # below floor: bucket 8
+        np.testing.assert_array_equal(got_full, expected)
+        np.testing.assert_array_equal(got_padded, expected[:11])
+        np.testing.assert_array_equal(got_small, expected[:3])
+        # the wire added no recompiles either
+        assert wire_served.service.engine.compile_count == 2
+
+    def test_uint8_wire_path_matches_converted_float(self, wire_served):
+        """A uint8 client gets bitwise the embeddings of the documented
+        x/255 float conversion (and ships 4x fewer payload bytes)."""
+        from tests.test_serving import _extractor_features
+        rng = np.random.RandomState(12)
+        u8 = rng.randint(0, 256, size=(8, 16, 16, 3), dtype=np.uint8)
+        as_float = u8.astype(np.float32) / np.float32(255.0)
+        expected = _extractor_features(wire_served, as_float)
+        host, port = wire_served.server.address
+        with EmbedClient(host, port, timeout_s=300.0) as c:
+            got = c.embed(u8)
+        np.testing.assert_array_equal(got, expected)
+
+    def test_wire_phases_reach_serve_stats(self, wire_served):
+        """serve_stats' additive wire block carries the HTTP status
+        histogram and read/parse/wait/write means, and round-trips the
+        strict event schema."""
+        from byol_tpu.observability.events import RunLog, read_events
+        meter = wire_served.service.meter
+        snap = meter.snapshot(time.perf_counter(), reset=False)
+        wire = snap.get("wire")
+        assert wire is not None
+        assert wire["status"].get("200", 0) >= 1
+        assert set(wire["phase_ms"]) <= {"read", "parse", "wait", "write"}
+        assert wire["phase_ms"]["wait"] >= 0.0
+        import tempfile, os
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "serve.jsonl")
+            with RunLog(path) as log:
+                meter.emit(log, time.perf_counter(), reset=False,
+                           compile_count=2)
+            events = list(read_events(path))
+        assert events[0]["kind"] == "serve_stats"
+        assert events[0]["wire"]["status"]["200"] >= 1

@@ -58,6 +58,27 @@ def test_disabled_grapher_is_noop(tmp_path):
     assert not os.path.exists(tmp_path / "off")
 
 
+def test_jsonl_nonfinite_scalar_stays_strict_json(tmp_path):
+    """GL110 (ISSUE 13 satellite): a diverged run's NaN/inf metric lands
+    in metrics.jsonl as the events.py string convention — every line
+    stays STRICT JSON (no bare NaN tokens), parseable by readers that
+    reject Python's lenient extension."""
+    g = Grapher("jsonl", logdir=str(tmp_path), run_name="n", enabled=True)
+    g.register_plots({"loss_mean": float("nan"),
+                      "grad_mean": float("inf")}, step=1, prefix="train")
+    g.close()
+
+    def strict(line):
+        # parse_constant fires only on NaN/Infinity/-Infinity tokens —
+        # exactly what must never appear
+        return json.loads(line, parse_constant=lambda tok: (_ for _ in ())
+                          .throw(AssertionError(f"bare {tok} token")))
+
+    lines = [strict(l) for l in open(tmp_path / "n" / "metrics.jsonl")]
+    assert any(l.get("train_loss_mean") == "NaN" for l in lines)
+    assert any(l.get("train_grad_mean") == "Infinity" for l in lines)
+
+
 def test_make_grid_shape_and_downscale():
     grid = make_grid(np.random.rand(10, 128, 128, 3), max_px=64)
     rows, cols = 3, 4  # ceil(sqrt(10))=4 cols, ceil(10/4)=3 rows

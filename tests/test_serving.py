@@ -478,22 +478,47 @@ class TestCompileDiscipline:
 
 
 class _StubEngine:
-    """Engine double for service-policy tests: instant, jax-free."""
+    """Engine double for service-policy tests: instant, jax-free.
+
+    Implements the worker's REAL surface (dispatch/readback, the
+    pipelined split) — dispatch "computes" eagerly and readback hands the
+    result over, so the stub exercises the worker's in-flight plumbing
+    without an accelerator."""
 
     input_shape = (4, 4, 3)              # matches _img()'s default rows
 
-    def __init__(self, fail_rows=()):
+    def __init__(self, fail_rows=(), dispatch_delay_s=0.0):
         self.buckets = BucketSpec(min_bucket=8, max_bucket=16)
         self.compile_count = len(self.buckets.sizes)
         self.fail_rows = set(fail_rows)
+        self.dispatch_delay_s = dispatch_delay_s
+        self.max_concurrent_inflight = 0
+        self._inflight = 0
 
-    def embed(self, rows, timeline=None):
+    def dispatch(self, rows, timeline=None):
         if rows.shape[0] in self.fail_rows:
             raise RuntimeError(f"boom at {rows.shape[0]} rows")
+        if self.dispatch_delay_s:
+            time.sleep(self.dispatch_delay_s)
         if timeline is not None:
             t = time.perf_counter()
-            timeline.update(stage=t, dispatch=t, readback=t)
-        return rows.reshape(rows.shape[0], -1)[:, :4].astype(np.float32)
+            timeline.update(stage=t, dispatch=t)
+        self._inflight += 1
+        self.max_concurrent_inflight = max(self.max_concurrent_inflight,
+                                           self._inflight)
+        out = rows.reshape(rows.shape[0], -1)[:, :4].astype(np.float32)
+        return types.SimpleNamespace(
+            out=out, rows=int(rows.shape[0]),
+            bucket=self.buckets.bucket_for(rows.shape[0]))
+
+    def readback(self, inflight, timeline=None):
+        self._inflight -= 1
+        if timeline is not None:
+            timeline["readback"] = time.perf_counter()
+        return inflight.out
+
+    def embed(self, rows, timeline=None):
+        return self.readback(self.dispatch(rows, timeline), timeline)
 
 
 class TestServicePolicy:
@@ -662,3 +687,121 @@ class TestServicePolicy:
         svc.stop()
         assert len(done) == 80 and set(done) == {(1, 4)}
         assert svc.meter.total_requests == 80
+
+
+# ---------------------------------------------------------------------------
+# 5. async dispatch pipelining (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+class TestBatcherNonblockingProbe:
+    def test_empty_vs_closed_vs_batch(self):
+        """next_batch(block=False) distinguishes the three worker states:
+        a batch when traffic is queued, EMPTY when open-but-idle (read
+        back in-flight work now), None when closed AND drained (exit)."""
+        from byol_tpu.serving.batcher import EMPTY
+        b = DynamicBatcher(max_batch=8, max_wait_s=0.001)
+        assert b.next_batch(block=False) is EMPTY
+        b.submit(_img(), timeout=0.1)
+        batch = b.next_batch(block=False)
+        assert batch is not EMPTY and len(batch) == 1
+        b.submit(_img(6), timeout=0.1)
+        b.submit(_img(5), timeout=0.1)        # 6+5 > 8: carried
+        b.next_batch(block=False)
+        assert [r.rows for r in b.next_batch(block=False)] == [5]  # carry
+        b.close()                             # counts as available
+        assert b.next_batch(block=False) is None
+
+    def test_trace_id_override(self):
+        """A caller-supplied trace id (the wire's X-Request-Id) rides the
+        request verbatim; absent, the counter assigns one."""
+        b = DynamicBatcher(max_batch=8)
+        req = b.submit(_img(), timeout=0.1, trace_id="wire-77")
+        assert req.trace_id == "wire-77"
+        auto = b.submit(_img(), timeout=0.1)
+        assert isinstance(auto.trace_id, int)
+
+
+class TestDispatchPipelining:
+    def test_results_map_to_their_requests_and_match_unpipelined(self):
+        """Same distinct-valued burst through pipeline off and on: every
+        request gets ITS OWN rows back (no reordering, no cross-batch
+        mixup) and the two modes' results are identical."""
+        outs = {}
+        for pipeline in ("off", "on"):
+            svc = EmbeddingService(
+                _StubEngine(),
+                DynamicBatcher(max_batch=16, max_wait_s=0.005),
+                pipeline=pipeline)
+            reqs = []
+            for i in range(40):   # > 2 batches: the pipeline must turn over
+                img = np.full((1, 4, 4, 3), float(i), np.float32)
+                reqs.append(svc.batcher.submit(img, timeout=1.0))
+            svc.start(warmup=False)
+            got = np.stack([r.result(timeout=30.0)[0] for r in reqs])
+            svc.stop()
+            np.testing.assert_array_equal(got,
+                                          np.repeat(np.arange(40.0,
+                                                    dtype=np.float32)[:, None],
+                                                    4, axis=1))
+            outs[pipeline] = got
+        np.testing.assert_array_equal(outs["off"], outs["on"])
+
+    def test_pipelined_worker_overlaps_two_batches(self):
+        """The mechanism pin: with pipelining on, the worker dispatches
+        batch i+1 BEFORE reading back batch i (stub engine observes two
+        concurrent in-flight batches); with it off, never."""
+        for pipeline, expected_max in (("off", 1), ("on", 2)):
+            engine = _StubEngine()
+            svc = EmbeddingService(
+                engine, DynamicBatcher(max_batch=16, max_wait_s=0.005),
+                pipeline=pipeline)
+            # enqueue a burst BEFORE starting the worker: > max_batch rows
+            # guarantees at least two coalesced batches back-to-back
+            reqs = [svc.batcher.submit(_img(), timeout=1.0)
+                    for _ in range(24)]
+            svc.start(warmup=False)
+            for r in reqs:
+                r.result(timeout=30.0)
+            svc.stop()
+            assert engine.max_concurrent_inflight == expected_max, pipeline
+
+    def test_pipelined_stop_drains_dispatched_batches(self):
+        """stop() during a pipelined burst still resolves EVERY accepted
+        request — dispatched-but-unread batches are read back on the
+        drain path, not dropped."""
+        svc = EmbeddingService(
+            _StubEngine(dispatch_delay_s=0.002),
+            DynamicBatcher(max_batch=8, max_wait_s=0.001),
+            pipeline="on")
+        svc.start(warmup=False)
+        reqs = [svc.submit(_img()) for _ in range(30)]
+        svc.stop()
+        for r in reqs:
+            assert r.result(timeout=1.0).shape == (1, 4)
+
+    def test_pipeline_bitwise_parity_on_real_engine(self, served):
+        """Off vs on around the SAME warmed engine (identical
+        executables): bitwise-equal embeddings, zero extra compiles —
+        pipelining changes host/device overlap, nothing else."""
+        engine = served.service.engine
+        rng = np.random.RandomState(21)
+        images = rng.rand(12, 16, 16, 3).astype(np.float32)
+        outs = {}
+        for pipeline in ("off", "on"):
+            svc = EmbeddingService(
+                engine, DynamicBatcher(max_batch=16, max_wait_s=0.005),
+                pipeline=pipeline)
+            svc.start(warmup=True)
+            compiles_before = engine.compile_count
+            reqs = [svc.submit(images[i]) for i in range(12)]
+            outs[pipeline] = np.stack(
+                [r.result(timeout=120.0)[0] for r in reqs])
+            svc.stop()
+            assert engine.compile_count == compiles_before
+        np.testing.assert_array_equal(outs["off"], outs["on"])
+
+    def test_invalid_pipeline_mode_rejected(self):
+        with pytest.raises(ValueError, match="pipeline"):
+            EmbeddingService(_StubEngine(),
+                             DynamicBatcher(max_batch=8),
+                             pipeline="double")

@@ -8,6 +8,7 @@ from tools.graphlint.rules.cli_drift import CliDriftRule
 from tools.graphlint.rules.collective_axes import CollectiveAxesRule
 from tools.graphlint.rules.donate import DonateRule
 from tools.graphlint.rules.host_sync import HostSyncRule
+from tools.graphlint.rules.json_nan import JsonNanRule
 from tools.graphlint.rules.pallas_interpret import PallasInterpretRule
 from tools.graphlint.rules.prng import PRNGReuseRule
 from tools.graphlint.rules.recompile import RecompileRule
@@ -19,4 +20,4 @@ def all_rules() -> List[Rule]:
     return [HostSyncRule(), RecompileRule(), PRNGReuseRule(),
             DonateRule(), RematTagRule(), CliDriftRule(),
             ShardingAxesRule(), CollectiveAxesRule(),
-            PallasInterpretRule()]
+            PallasInterpretRule(), JsonNanRule()]

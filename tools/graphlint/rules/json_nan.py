@@ -1,0 +1,85 @@
+"""GL110 — json.dump(s) without ``allow_nan=False`` (bare-NaN hazard).
+
+The PR 6 lesson, promoted to a rule: Python's json writer is LENIENT by
+default — a non-finite float serializes as the bare token ``NaN`` /
+``Infinity``, which is not JSON.  jq, JavaScript, serde, and every
+strict parser reject the line, and the lines most likely to carry a NaN
+are exactly the ones the logs exist to capture (an anomaly snapshot, a
+diverged metric).  observability/events.py is the in-tree fix — sanitize
+non-finite floats to strings, then ``json.dumps(..., allow_nan=False)``
+so nothing lenient can slip through — and every OTHER writer in the
+package must either reuse it or carry its own ``allow_nan=False``.
+
+This rule flags any call resolving to ``json.dump`` / ``json.dumps``
+that does not pass a literal ``allow_nan=False``:
+
+- no ``allow_nan`` keyword at all → the lenient default, flagged;
+- ``allow_nan=True`` (or any non-``False`` literal) → explicitly
+  lenient, flagged;
+- ``allow_nan=<expression>`` → cannot be judged statically, stands down;
+- a ``**kwargs`` splat may carry it invisibly → stands down
+  (the GL109 zero-false-positive contract).
+
+``observability/events.py`` itself is exempt: it is the module that
+OWNS the sanitize-then-strict discipline, and its internal dumps are
+the implementation of the contract the rule enforces elsewhere.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.graphlint.astutil import qualname
+from tools.graphlint.engine import Context, Finding, LintedFile, Rule
+
+_EXEMPT_SUFFIX = "observability/events.py"
+_TARGETS = ("json.dump", "json.dumps")
+
+
+def _is_json_dump(node: ast.Call, f: LintedFile) -> bool:
+    q = qualname(node.func, f.imports)
+    if not q:
+        return False
+    return q in _TARGETS or any(q.endswith("." + t) for t in _TARGETS)
+
+
+class JsonNanRule(Rule):
+    id = "GL110"
+    name = "json-bare-nan"
+    doc = ("json.dump/dumps without allow_nan=False emits bare NaN "
+           "tokens strict parsers reject — sanitize non-finite floats "
+           "and pass allow_nan=False (the events.py discipline)")
+
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        if f.rel.replace("\\", "/").endswith(_EXEMPT_SUFFIX):
+            return findings
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call) or not _is_json_dump(node,
+                                                                   f):
+                continue
+            kwarg_names = {kw.arg for kw in node.keywords}
+            if None in kwarg_names:
+                continue           # **kwargs may forward allow_nan=
+            allow = next((kw for kw in node.keywords
+                          if kw.arg == "allow_nan"), None)
+            if allow is not None:
+                if not isinstance(allow.value, ast.Constant):
+                    continue       # computed value: cannot judge, stand
+                if allow.value.value is False:        # down (GL109 rule)
+                    continue
+                findings.append(self.finding(
+                    f, node, "json.dump(s) with an explicitly lenient "
+                    "allow_nan — a non-finite float becomes a bare NaN "
+                    "token no strict JSON parser accepts; sanitize to "
+                    "strings and pass allow_nan=False "
+                    "(observability/events.py is the pattern)"))
+                continue
+            findings.append(self.finding(
+                f, node, "json.dump(s) without allow_nan=False — the "
+                "lenient default writes bare NaN/Infinity tokens that "
+                "jq/JS/serde reject, exactly on the anomalous runs the "
+                "output exists to capture; sanitize non-finite floats "
+                "to strings and pass allow_nan=False "
+                "(observability/events.py is the pattern)"))
+        return findings
