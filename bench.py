@@ -89,6 +89,15 @@ Usage:
                                    #   must scale ~1/N with mesh size).
                                    #   --cpu-devices N sizes the virtual
                                    #   CPU mesh for off-hardware captures
+  python bench.py --augment-ab     # fused-augmentation A/B: the step-
+                                   #   placement config with the XLA op
+                                   #   chain (--fused-augment off) vs the
+                                   #   fused Pallas kernel (on), both arms
+                                   #   AOT-compiled and timed under a live
+                                   #   SpanRecorder (wall + train/dispatch
+                                   #   span p50 -> bench_events.jsonl), plus
+                                   #   an in-process microbench row: bare
+                                   #   two_view XLA chain vs fused call
   python bench.py --serve-ladder   # embedding-service latency/throughput
                                    #   at 1/8/64 closed-loop streams;
                                    #   --serve-pipeline off|on|ab A/Bs the
@@ -197,7 +206,8 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
            accum_steps: int = 1, accum_bn_mode: str = "average",
            remat_policy: str = "none", augment_placement: str = "loader",
            telemetry: str = "off", zero1: str = "off",
-           fused_update: str = "off", materialize_batch: bool = True):
+           fused_update: str = "off", fused_augment: str = "off",
+           materialize_batch: bool = True):
     from byol_tpu.core.config import (Config, DeviceConfig, ModelConfig,
                                       OptimConfig, ParityConfig, TaskConfig,
                                       resolve)
@@ -209,7 +219,8 @@ def _build(batch_size: int, image_size: int, arch: str, *, half: bool,
     cfg = Config(
         task=TaskConfig(task="fake", batch_size=batch_size * n_dev, epochs=100,
                         image_size_override=image_size,
-                        augment_placement=augment_placement),
+                        augment_placement=augment_placement,
+                        fused_augment=fused_augment),
         model=ModelConfig(arch=arch, fuse_views=fuse_views, remat=remat,
                           remat_policy=remat_policy,
                           stem=stem, attn_impl=attn_impl),
@@ -632,7 +643,8 @@ def main():
         mode = {"--sweep", "--profile", "--stem-ab", "--mvc",
                 "--accum-ladder", "--dry-compile", "--input-ladder",
                 "--telemetry-ab", "--spans-ab", "--zero1-ab",
-                "--fused-ab", "--serve-ladder", "--wire-ladder"} \
+                "--fused-ab", "--augment-ab", "--serve-ladder",
+                "--wire-ladder"} \
             & set(sys.argv[1:])
         if mode:
             # only the headline has a committed artifact to fall back to;
@@ -771,6 +783,9 @@ def main():
         return
     if "--fused-ab" in sys.argv[1:]:
         _fused_ab(arch, image_size, on_tpu, attn_impl)
+        return
+    if "--augment-ab" in sys.argv[1:]:
+        _augment_ab(arch, image_size, on_tpu, attn_impl)
         return
     if "--serve-ladder" in sys.argv[1:]:
         _serve_ladder(arch, image_size, on_tpu, attn_impl)
@@ -1918,6 +1933,121 @@ def _fused_ab(arch, image_size, on_tpu, attn_impl):
     overhead = 1.0 - rates["on"] / rates["off"]
     print(json.dumps({
         "metric": "fused_update_ab",
+        "value": round(rates["on"], 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(rates["on"] / rates["off"], 4),
+        "off_images_per_sec_per_chip": round(rates["off"], 2),
+        "on_images_per_sec_per_chip": round(rates["on"], 2),
+        "step_overhead_pct": round(100.0 * overhead, 2),
+        "dispatch_span_p50_ms": span_p50,
+        "microbench": row,
+        "batch_per_chip": bs, "arch": arch, "image_size": image_size,
+        "timing_steps": steps,
+        "device_kind": jax.devices()[0].device_kind,
+    }))
+
+
+def _augment_ab(arch, image_size, on_tpu, attn_impl):
+    """Fused-augmentation A/B (``--augment-ab``): the step-placement
+    config (raw uint8 batches, in-step two-view augmentation) AOT-compiled
+    with the XLA op chain (``--fused-augment off`` — the exact unfused
+    graph, pinned byte-identical by test) and with the fused Pallas
+    augmentation kernel (``on``; ops/fused_augment.py), each arm timed
+    under a live :class:`spans.SpanRecorder` wrapping every step dispatch
+    plus the closing readback — wall rate + per-step dispatch-span stats
+    into ``bench_events.jsonl`` as bench_row + span_stats, the same
+    flight-recorder currency the trainer logs.
+
+    Also records an IN-PROCESS input-path microbenchmark row: the bare
+    two-view augmentation (``device_augment.two_view`` XLA chain vs
+    ``fused_two_view``) on a synthetic uint8 batch, each on its own
+    executable — the number that isolates the input path from the model
+    around it.  NB on CPU the fused arm runs under the Pallas INTERPRETER
+    (one XLA op dispatched per kernel instruction — correctness-grade,
+    not speed-grade): the CPU capture documents mechanism and event
+    plumbing; the TPU row (ROADMAP capture batch) is the perf claim.
+    """
+    import jax.numpy as jnp
+
+    from byol_tpu.data import device_augment
+    from byol_tpu.observability import goodput as goodput_lib
+    from byol_tpu.observability import spans as spans_lib
+    from byol_tpu.ops import fused_augment as fused_aug_lib
+    bs = 256 if on_tpu else 16
+    steps = 60 if on_tpu else 30
+    rates, span_p50 = {}, {}
+    for mode in ("off", "on"):
+        state, train_step, batch, mesh = _build(
+            bs, image_size, arch, half=on_tpu, fuse_views=True,
+            ema_update_mode="post", attn_impl=attn_impl,
+            augment_placement="step", fused_augment=mode)
+        compiled, stats = _aot_compile(train_step, state, batch, mesh)
+        recorder = spans_lib.SpanRecorder()
+        for _ in range(3):                       # warm; sync via readback
+            state, metrics = compiled(state, batch)
+        float(metrics["loss_mean"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            with recorder.span("train/dispatch"):
+                state, metrics = compiled(state, batch)
+        with recorder.span("train/epoch_readback"):
+            float(metrics["loss_mean"])
+        dt = time.perf_counter() - t0
+        n_dev = len(jax.devices())
+        rates[mode] = batch["label"].shape[0] * steps / dt / n_dev
+        sstats = goodput_lib.span_stats(recorder.records())
+        span_p50[mode] = sstats.get("train/dispatch", {}).get("p50_ms")
+        if _events is not None:
+            _events.emit("span_stats", scope="epoch",
+                         label=f"augment_{mode}", spans=sstats)
+        _record(f"augment_{mode}", fit=True, batch_per_chip=bs,
+                fused_augment=mode, augment_placement="step",
+                images_per_sec_per_chip=round(rates[mode], 2),
+                dispatch_span_p50_ms=span_p50[mode], **stats)
+        print(f"bench: augment_{mode}: {rates[mode]:.2f} img/s/chip "
+              f"(dispatch p50 {span_p50[mode]}ms)", file=sys.stderr)
+
+    # ---- in-process input-path microbenchmark --------------------------
+    # the bare two-view program on a raw uint8 microbatch: XLA op chain
+    # vs one fused kernel call (+ its blur conv), both jitted standalone
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.integers(
+        0, 256, (bs, image_size, image_size, 3), dtype=np.uint8))
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def xla_chain(k, im):
+        return device_augment.two_view(k, im, image_size)
+
+    @jax.jit
+    def fused(k, im):
+        return fused_aug_lib.fused_two_view(k, im, image_size)
+
+    def bench_fn(fn, args, reps=5, inner=3):
+        out = fn(*args)                       # compile + warm
+        jax.block_until_ready(out)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(inner):
+                jax.block_until_ready(fn(*args))
+            times.append((time.perf_counter() - t0) / inner)
+        return float(np.median(times))
+
+    t_chain = bench_fn(xla_chain, (key, imgs))
+    t_fused = bench_fn(fused, (key, imgs))
+    row = {
+        "batch": bs,
+        "image_size": image_size,
+        "xla_chain_us": round(t_chain * 1e6, 1),
+        "fused_kernel_us": round(t_fused * 1e6, 1),
+        "fused_speedup": round(t_chain / t_fused, 3),
+        "interpret_mode": not on_tpu,
+    }
+    _record("augment_microbench", fit=True, **row)
+    overhead = 1.0 - rates["on"] / rates["off"]
+    print(json.dumps({
+        "metric": "fused_augment_ab",
         "value": round(rates["on"], 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(rates["on"] / rates["off"], 4),

@@ -203,6 +203,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "sweeps -> ~1; shard-local under --zero1 on).  "
                         "Requires --optimizer lars_momentum with --clip 0; "
                         "'off' lowers the exact unfused graph")
+    x.add_argument("--fused-augment", type=str, default="off",
+                   choices=("off", "on"),
+                   help="fused in-step augmentation (ops/fused_augment.py "
+                        "Pallas kernel): 'on' collapses the per-view "
+                        "crop/flip/jitter/grayscale chain into one VMEM "
+                        "pass per image (blur stays an MXU conv on the "
+                        "kernel's output; randomness still drawn from the "
+                        "augment_keys stream outside the kernel).  "
+                        "Requires --augment-placement step; 'off' lowers "
+                        "the exact unfused graph")
     x.add_argument("--fuse-views", action="store_true",
                    help="one fused encoder call for both views (perf; "
                         "changes BN batch statistics vs the reference)")
@@ -316,6 +326,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             grapher=args.grapher,
             data_backend=args.data_backend,
             augment_placement=args.augment_placement,
+            fused_augment=args.fused_augment,
             num_synth_samples=args.num_synth_samples,
             valid_fraction=args.valid_fraction),
         model=ModelConfig(

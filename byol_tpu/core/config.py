@@ -52,6 +52,16 @@ class TaskConfig:
     #   of float32 views is ever live in HBM and the separate augment
     #   dispatch disappears (training/steps.py).
     augment_placement: str = "loader"
+    # Fused in-step augmentation (ops/fused_augment.py): 'on' replaces the
+    # per-view chain of ~7 XLA ops the step-placement augmentation traces
+    # (crop-gather, flip, jitter, grayscale — each an HBM sweep of the
+    # microbatch) with one Pallas kernel pass per image (uint8 convert +
+    # crop + flip + jitter + grayscale in VMEM; the separable blur stays
+    # an MXU depthwise conv on the kernel's output), shard-local over the
+    # data axis.  Requires augment_placement='step' (validated at
+    # resolve()); 'off' lowers the exact unfused graph (HLO identity
+    # pinned by test).
+    fused_augment: str = "off"
     # Dataset size for the offline-learnable 'synth' task (test split is
     # 1/10th); committed evidence runs use this to stay reproducible from
     # the CLI alone.  0 = loader default (20k).
@@ -395,6 +405,30 @@ def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
                 "--model-parallel > 1 (tensor parallelism shards head "
                 "opt-state leaves over 'model'; the fused kernel's flat "
                 "buffer would un-shard them every step)")
+    if cfg.task.fused_augment not in ("off", "on"):
+        raise ValueError(
+            f"unknown fused_augment mode {cfg.task.fused_augment!r}; "
+            "'off' | 'on'")
+    if cfg.task.fused_augment == "on":
+        if cfg.task.augment_placement != "step":
+            raise ValueError(
+                "--fused-augment on requires --augment-placement step: "
+                "the kernel fuses the IN-STEP augmentation path (raw "
+                "uint8 batches augmented inside the accumulation scan); "
+                "with loader placement there is no in-step chain to fuse")
+        if cfg.optim.accum_bn_mode == "global" and accum > 1:
+            raise ValueError(
+                "--fused-augment on does not compose with --accum-bn-mode "
+                "global: the global oracle vmaps microbatches, and the "
+                "augment kernel's pallas_call/shard_map cannot run under "
+                "that vmap — use 'average' or 'microbatch'")
+        if (cfg.device.model_parallel > 1
+                or cfg.device.sequence_parallel > 1):
+            raise ValueError(
+                "--fused-augment on spans the data axis only (the "
+                "kernel's shard_map augments each chip's batch shard); "
+                "model/sequence-parallel meshes are not yet supported — "
+                "run those with --fused-augment off")
     if cfg.device.nan_policy == "halt" and cfg.device.telemetry == "off":
         # the sink that enforces halt only exists when telemetry is on —
         # accepting this combination would silently train through NaNs,

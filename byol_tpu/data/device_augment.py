@@ -13,11 +13,28 @@ Unlike the reference's DALI path, which silently changes augmentation
 hyperparameters (HFlip .2 vs .5, saturation .2s vs .8s, no blur — Quirk Q4,
 accuracy caveat README.md:93), this path uses the SAME canonical parameters
 as the host pipeline (data/augment.py).
+
+Since ISSUE 14 every stochastic DRAW is factored away from its APPLY
+(:func:`crop_window` / :func:`jitter_params` / :func:`blur_sigma` /
+:func:`view_params` vs :func:`apply_crop` / :func:`apply_color_jitter` /
+:func:`apply_gaussian_blur` / :func:`apply_view`): the fused Pallas
+augmentation kernel (ops/fused_augment.py) draws its per-image parameters
+from the SAME functions outside the ``pallas_call`` (host-RNG primitives do
+not exist in-kernel — graphlint GL111) and applies the same arithmetic
+in-kernel, so the two paths share every line that could drift.  The
+factoring preserves the key streams and op order exactly (``augment_one``
+splits the same key the same way as before), with ONE deliberate
+numerical exception: the hue rotation is rewritten scalar-unrolled (a
+kernel body cannot capture the constant YIQ matrices), replacing three
+einsums with the equivalent per-channel arithmetic — identical math,
+fp-rounding-level differences only (and on TPU the old einsums were
+MXU-eligible, so pre-refactor seed-for-seed trajectories there are
+reproduced to tolerance, not bit-for-bit).
 """
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -27,26 +44,28 @@ def _uniform(key, lo=0.0, hi=1.0, shape=()):
     return jax.random.uniform(key, shape, minval=lo, maxval=hi)
 
 
-def random_resized_crop(key, image: jnp.ndarray, size: int,
-                        scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)
-                        ) -> jnp.ndarray:
-    """torchvision RandomResizedCrop with static output shape.
-
-    Samples area in ``scale``·A and log-uniform aspect in ``ratio``; the
-    (fractional) window is mapped to (size, size) by scale_and_translate —
-    no dynamic shapes, so XLA tiles it cleanly."""
-    h, w = image.shape[0], image.shape[1]
+def crop_window(key, h: int, w: int, scale=(0.08, 1.0),
+                ratio=(3 / 4, 4 / 3)):
+    """Draw one RandomResizedCrop window: ``(y0, x0, ch, cw)`` fractional
+    offsets/extents in source pixels (area in ``scale``·A, log-uniform
+    aspect in ``ratio``, clamped to the image — the torchvision
+    fallback-to-whole-image analog)."""
     k_area, k_ratio, k_y, k_x = jax.random.split(key, 4)
     area = _uniform(k_area, scale[0], scale[1]) * (h * w)
     log_r = _uniform(k_ratio, jnp.log(ratio[0]), jnp.log(ratio[1]))
     r = jnp.exp(log_r)
     cw = jnp.sqrt(area * r)
     ch = jnp.sqrt(area / r)
-    # clamp to the image (the torchvision fallback-to-whole-image analog)
     cw = jnp.minimum(cw, w * 1.0)
     ch = jnp.minimum(ch, h * 1.0)
     y0 = _uniform(k_y, 0.0, h - ch)
     x0 = _uniform(k_x, 0.0, w - cw)
+    return y0, x0, ch, cw
+
+
+def apply_crop(image: jnp.ndarray, y0, x0, ch, cw, size: int) -> jnp.ndarray:
+    """Map the (fractional) crop window to (size, size) by
+    scale_and_translate — no dynamic shapes, so XLA tiles it cleanly."""
     sy, sx = size / ch, size / cw
     out = jax.image.scale_and_translate(
         image, (size, size, image.shape[2]), (0, 1),
@@ -56,48 +75,83 @@ def random_resized_crop(key, image: jnp.ndarray, size: int,
     return jnp.clip(out, 0.0, 1.0)
 
 
+def random_resized_crop(key, image: jnp.ndarray, size: int,
+                        scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)
+                        ) -> jnp.ndarray:
+    """torchvision RandomResizedCrop with static output shape."""
+    y0, x0, ch, cw = crop_window(key, image.shape[0], image.shape[1],
+                                 scale, ratio)
+    return apply_crop(image, y0, x0, ch, cw, size)
+
+
 def _gray(image):
     lum = (0.2989 * image[..., 0] + 0.587 * image[..., 1]
            + 0.114 * image[..., 2])
     return lum[..., None]
 
 
-def color_jitter(key, image: jnp.ndarray, strength: float) -> jnp.ndarray:
-    """brightness/contrast/saturation (.8s) + hue (.2s), torch semantics
-    (multiplicative brightness; blend-based contrast/saturation)."""
+def apply_grayscale(image: jnp.ndarray) -> jnp.ndarray:
+    """Three-channel grayscale (the torchvision RandomGrayscale branch)."""
+    return jnp.tile(_gray(image), (1, 1, 3))
+
+
+def jitter_params(key, strength: float):
+    """Draw the color-jitter factors: multiplicative brightness, blend
+    contrast/saturation factors, and the hue angle (inert when
+    ``0.2 * strength == 0``)."""
     b = c = s = 0.8 * strength
     hs = 0.2 * strength
     kb, kc, ks, kh = jax.random.split(key, 4)
-    image = jnp.clip(image * _uniform(kb, max(0., 1 - b), 1 + b), 0., 1.)
-    f = _uniform(kc, max(0., 1 - c), 1 + c)
-    image = jnp.clip(f * image + (1 - f) * jnp.mean(_gray(image)), 0., 1.)
-    f = _uniform(ks, max(0., 1 - s), 1 + s)
-    image = jnp.clip(f * image + (1 - f) * _gray(image), 0., 1.)
-    if hs > 0:
+    fb = _uniform(kb, max(0., 1 - b), 1 + b)
+    fc = _uniform(kc, max(0., 1 - c), 1 + c)
+    fs = _uniform(ks, max(0., 1 - s), 1 + s)
+    theta = _uniform(kh, -hs, hs) * 2.0 * jnp.pi
+    return fb, fc, fs, theta
+
+
+def apply_color_jitter(image: jnp.ndarray, fb, fc, fs, theta, *,
+                       hue: bool) -> jnp.ndarray:
+    """brightness/contrast/saturation (.8s) + hue (.2s), torch semantics
+    (multiplicative brightness; blend-based contrast/saturation); pure
+    arithmetic on pre-drawn factors, shared verbatim by the fused
+    augmentation kernel body."""
+    image = jnp.clip(image * fb, 0., 1.)
+    image = jnp.clip(fc * image + (1 - fc) * jnp.mean(_gray(image)), 0., 1.)
+    image = jnp.clip(fs * image + (1 - fs) * _gray(image), 0., 1.)
+    if hue:
         # hue rotation in YIQ space (equivalent to HSV hue shift, cheaper
-        # and branch-free on TPU)
-        theta = _uniform(kh, -hs, hs) * 2.0 * jnp.pi
-        yiq = jnp.einsum("hwc,cd->hwd", image,
-                         jnp.array([[0.299, 0.596, 0.211],
-                                    [0.587, -0.274, -0.523],
-                                    [0.114, -0.322, 0.312]]))
+        # and branch-free on TPU), written in scalar-unrolled form: a
+        # Pallas kernel body cannot capture array constants, and this
+        # function IS the fused augmentation kernel's jitter stage
+        # (ops/fused_augment.py) — scalar coefficients inline fine and the
+        # channel mixes stay pure VPU arithmetic either way
+        r, g, b_ = image[..., 0], image[..., 1], image[..., 2]
+        y = 0.299 * r + 0.587 * g + 0.114 * b_
+        i = 0.596 * r - 0.274 * g - 0.322 * b_
+        q = 0.211 * r - 0.523 * g + 0.312 * b_
         cos, sin = jnp.cos(theta), jnp.sin(theta)
-        rot = jnp.array([[1, 0, 0], [0, cos, -sin], [0, sin, cos]],
-                        dtype=image.dtype)
-        yiq = jnp.einsum("hwd,de->hwe", yiq, rot)
-        image = jnp.einsum("hwd,dc->hwc", yiq,
-                           jnp.array([[1.0, 1.0, 1.0],
-                                      [0.956, -0.272, -1.106],
-                                      [0.621, -0.647, 1.703]]))
+        i, q = cos * i + sin * q, -sin * i + cos * q
+        image = jnp.stack([y + 0.956 * i + 0.621 * q,
+                           y - 0.272 * i - 0.647 * q,
+                           y - 1.106 * i + 1.703 * q], axis=-1)
         image = jnp.clip(image, 0.0, 1.0)
     return image
 
 
-def gaussian_blur(key, image: jnp.ndarray, kernel_size: int,
-                  sigma_range=(0.1, 2.0)) -> jnp.ndarray:
-    """Separable depthwise gaussian blur; per-image sigma."""
+def color_jitter(key, image: jnp.ndarray, strength: float) -> jnp.ndarray:
+    fb, fc, fs, theta = jitter_params(key, strength)
+    return apply_color_jitter(image, fb, fc, fs, theta,
+                              hue=0.2 * strength > 0)
+
+
+def blur_sigma(key, sigma_range=(0.1, 2.0)):
+    return _uniform(key, *sigma_range)
+
+
+def apply_gaussian_blur(sigma, image: jnp.ndarray,
+                        kernel_size: int) -> jnp.ndarray:
+    """Separable depthwise gaussian blur with a pre-drawn sigma."""
     k = max(int(kernel_size) | 1, 3)
-    sigma = _uniform(key, *sigma_range)
     x = jnp.arange(-(k // 2), k // 2 + 1, dtype=image.dtype)
     g = jnp.exp(-(x ** 2) / (2.0 * sigma ** 2))
     g = g / jnp.sum(g)
@@ -120,20 +174,75 @@ def gaussian_blur(key, image: jnp.ndarray, kernel_size: int,
     return img[0]
 
 
+def gaussian_blur(key, image: jnp.ndarray, kernel_size: int,
+                  sigma_range=(0.1, 2.0)) -> jnp.ndarray:
+    """Separable depthwise gaussian blur; per-image sigma."""
+    return apply_gaussian_blur(blur_sigma(key, sigma_range), image,
+                               kernel_size)
+
+
+class ViewParams(NamedTuple):
+    """Every stochastic parameter one view draws, in augment_one's key
+    order — the contract the fused kernel path (ops/fused_augment.py)
+    consumes OUTSIDE its ``pallas_call``.  A pytree of scalars: vmap over
+    a key batch for per-image parameter arrays."""
+
+    y0: jnp.ndarray          # crop window (crop_window)
+    x0: jnp.ndarray
+    ch: jnp.ndarray
+    cw: jnp.ndarray
+    flip: jnp.ndarray        # bool gates
+    jitter: jnp.ndarray
+    fb: jnp.ndarray          # jitter factors (jitter_params)
+    fc: jnp.ndarray
+    fs: jnp.ndarray
+    theta: jnp.ndarray
+    gray: jnp.ndarray
+    blur: jnp.ndarray
+    sigma: jnp.ndarray       # blur sigma (blur_sigma)
+
+
+def view_params(key, h: int, w: int,
+                strength: float = 1.0) -> ViewParams:
+    """Draw every parameter of one view from ``key`` — the exact split
+    structure augment_one has always used (7-way split; crop/jitter
+    subkeys split further inside their draw functions).  Gate and sigma
+    draw from independent keys (seed reuse would pin sigma to a
+    deterministic function of the gate draw)."""
+    ks = jax.random.split(key, 7)
+    y0, x0, ch, cw = crop_window(ks[0], h, w)
+    fb, fc, fs, theta = jitter_params(ks[3], strength)
+    return ViewParams(
+        y0=y0, x0=x0, ch=ch, cw=cw,
+        flip=_uniform(ks[1]) < 0.5,
+        jitter=_uniform(ks[2]) < 0.8,
+        fb=fb, fc=fc, fs=fs, theta=theta,
+        gray=_uniform(ks[4]) < 0.2,
+        blur=_uniform(ks[5]) < 0.5,
+        sigma=blur_sigma(ks[6]))
+
+
+def apply_view(p: ViewParams, image: jnp.ndarray, size: int, *,
+               strength: float = 1.0) -> jnp.ndarray:
+    """Apply one view's pre-drawn parameters (HWC float32 [0,1] in,
+    (size, size, C) float32 out); pure arithmetic — no RNG."""
+    v = apply_crop(image, p.y0, p.x0, p.ch, p.cw, size)
+    v = jnp.where(p.flip, v[:, ::-1, :], v)
+    v = jnp.where(p.jitter,
+                  apply_color_jitter(v, p.fb, p.fc, p.fs, p.theta,
+                                     hue=0.2 * strength > 0), v)
+    v = jnp.where(p.gray, apply_grayscale(v), v)
+    v = jnp.where(p.blur, apply_gaussian_blur(p.sigma, v, int(0.1 * size)),
+                  v)
+    return jnp.clip(v, 0.0, 1.0)
+
+
 def augment_one(key, image: jnp.ndarray, size: int,
                 color_jitter_strength: float = 1.0) -> jnp.ndarray:
     """One view for one image (HWC float32 [0,1]); vmap over the batch."""
-    ks = jax.random.split(key, 7)
-    v = random_resized_crop(ks[0], image, size)
-    v = jnp.where(_uniform(ks[1]) < 0.5, v[:, ::-1, :], v)
-    v = jnp.where(_uniform(ks[2]) < 0.8,
-                  color_jitter(ks[3], v, color_jitter_strength), v)
-    v = jnp.where(_uniform(ks[4]) < 0.2, jnp.tile(_gray(v), (1, 1, 3)), v)
-    # gate and sigma draw from independent keys (seed reuse would pin sigma
-    # to a deterministic function of the gate draw)
-    v = jnp.where(_uniform(ks[5]) < 0.5,
-                  gaussian_blur(ks[6], v, int(0.1 * size)), v)
-    return jnp.clip(v, 0.0, 1.0)
+    p = view_params(key, image.shape[0], image.shape[1],
+                    color_jitter_strength)
+    return apply_view(p, image, size, strength=color_jitter_strength)
 
 
 def two_view(key, images: jnp.ndarray, size: int, *,

@@ -1,0 +1,32 @@
+"""byol_tpu.ops — the in-tree accelerator kernels.
+
+One auditable home for every Pallas kernel the repo ships (the GL109
+discipline: kernels live here, each with an ``interpret=`` fallback so CPU
+tier-1 runs the real kernel code) plus the shared plumbing in
+:mod:`byol_tpu.ops.common`.  The public kernel API is re-exported here so
+call sites name the capability, not the file:
+
+- :func:`flash_attention` — tiled online-softmax attention (ViT backend).
+- :func:`fused_lars_ema_update` / :func:`fused_lars_ema_update_zero1` —
+  the fused LARS+EMA weight update over the flat segmented buffer
+  (``--fused-update on``), replicated and ZeRO-1 layouts.
+- :func:`fused_two_view` — the fused uint8→two-view augmentation
+  (``--fused-augment on``): one VMEM pass per image for
+  convert/crop/flip/jitter/grayscale, blur as an MXU conv on the output.
+"""
+from byol_tpu.ops.common import (LANES, TPU_BLOCK_ROWS, fat_tile,
+                                 resolve_block_rows, resolve_interpret)
+from byol_tpu.ops.flash_attention import flash_attention
+from byol_tpu.ops.fused_augment import crop_weight_mats, fused_two_view
+from byol_tpu.ops.fused_update import (SegmentMap, build_segment_map,
+                                       fused_lars_ema_update,
+                                       fused_lars_ema_update_zero1,
+                                       pack_flat, unpack_flat)
+
+__all__ = [
+    "LANES", "TPU_BLOCK_ROWS", "fat_tile", "resolve_block_rows",
+    "resolve_interpret", "flash_attention", "crop_weight_mats",
+    "fused_two_view", "SegmentMap", "build_segment_map",
+    "fused_lars_ema_update", "fused_lars_ema_update_zero1", "pack_flat",
+    "unpack_flat",
+]

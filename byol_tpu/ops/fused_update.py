@@ -77,31 +77,18 @@ from jax.experimental import pallas as pl
 from jax.sharding import PartitionSpec as P
 
 from byol_tpu.optim import lars as lars_lib
+from byol_tpu.ops import common as ops_common
+# Shared kernel plumbing (ops/common.py): interpret resolution + grid
+# sizing are one implementation for every in-tree kernel.  The names are
+# re-exported here because this module shipped them first (tests and the
+# bench microbenchmark import them from here).
+from byol_tpu.ops.common import (LANES as _LANES, TPU_BLOCK_ROWS,
+                                 resolve_block_rows)
 from byol_tpu.parallel.mesh import DATA_AXIS
 
-# TPU vector-lane width: the flat buffer is viewed as (rows, _LANES) and
-# every segment is padded to whole rows.
-_LANES = 128
-# Compiled-mode tile height: 256 rows x 128 lanes x 4 B = 128 KiB per fp32
-# operand — 7 operands/outputs in the apply pass stay under ~1 MiB of the
-# ~16 MiB VMEM.  Interpret mode ignores this and sizes tiles so the grid
-# is ~_INTERPRET_GRID steps (the interpreter pays per STEP, re-staging
-# operands each iteration — a fine grid is quadratic in buffer size).
-TPU_BLOCK_ROWS = 256
-_INTERPRET_GRID = 16
 
-
-def _shard_map(fn, mesh, in_specs, out_specs):
-    """Version shim (the ring_attention pattern): ``jax.shard_map`` on
-    jax >= 0.5, the experimental module before.  Replication checking is
-    disabled either way — pallas_call has no replication rule, and every
-    cross-shard value here is an explicit psum."""
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_rep=False)
-    from jax.experimental.shard_map import shard_map
-    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                     check_rep=False)
+# shared shard_map version shim (ops/common.py)
+_shard_map = ops_common.shard_map_compat
 
 
 # ---------------------------------------------------------------------------
@@ -159,21 +146,6 @@ def build_segment_map(sizes: Sequence[int],
     return SegmentMap(sizes=tuple(int(s) for s in sizes), padded=padded,
                       starts=starts,
                       adapted=tuple(bool(a) for a in adapted))
-
-
-def resolve_block_rows(num_rows: int, interpret: bool,
-                       block_rows: Optional[int] = None) -> int:
-    """Grid tile height: explicit override, else VMEM-sized on TPU and
-    ~:data:`_INTERPRET_GRID` fat tiles under the interpreter (multiple of
-    8, the fp32 sublane count)."""
-    if block_rows is not None:
-        if block_rows % 8:
-            raise ValueError(f"block_rows {block_rows} not a multiple of 8")
-        return block_rows
-    if not interpret:
-        return TPU_BLOCK_ROWS
-    target = -(-num_rows // _INTERPRET_GRID)      # ceil: ~16 grid steps
-    return max(8, -(-target // 8) * 8)
 
 
 def pack_flat(leaves: Sequence[jnp.ndarray], seg: SegmentMap,
@@ -263,8 +235,7 @@ def _fused_apply_kernel(p_ref, g_ref, m_ref, t_ref, wd_ref, sc_ref, hp_ref,
 
 
 def _resolve_interpret(interpret: Optional[bool]) -> bool:
-    return (jax.default_backend() != "tpu" if interpret is None
-            else interpret)
+    return ops_common.resolve_interpret(interpret)
 
 
 def _fused_update_lists(p_list, g_list, m_list, t_list, lr, tau, *,

@@ -132,6 +132,7 @@ def step_config(rcfg: ResolvedConfig) -> StepConfig:
         clip=cfg.optim.clip,
         fused_update=cfg.optim.fused_update == "on",
         augment_in_step=cfg.task.augment_placement == "step",
+        fused_augment=cfg.task.fused_augment == "on",
         image_size=rcfg.input_shape[0],
         color_jitter_strength=cfg.regularizer.color_jitter_strength,
         aug_seed=cfg.device.seed,
@@ -227,9 +228,10 @@ def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array,
     state, state_sh = plan.prepare_state(state, tx)
     z1 = plan.zero1_context()
 
-    # lr_schedule + mesh feed ONLY the fused-update path (the kernel needs
-    # the bare lr value and a mesh for its shard_map); with fused_update
-    # off they are inert and the traced graph is unchanged.
+    # lr_schedule + mesh feed ONLY the fused-kernel paths (fused_update
+    # needs the bare lr value; both fused kernels need a mesh for their
+    # shard_maps); with both fused flags off they are inert and the traced
+    # graph is unchanged.
     train_step = plan.jit_train_step(
         make_train_step(net, tx, scfg, policy, zero1_ctx=z1,
                         lr_schedule=schedule, mesh=mesh), state_sh)

@@ -123,6 +123,19 @@ class StepConfig:
     augment_in_step: bool = False        # --augment-placement step: batch is
                                          # raw uint8; two-view augmentation
                                          # runs inside the accumulation scan
+    fused_augment: bool = False          # --fused-augment on: the in-step
+                                         # two-view augmentation runs as the
+                                         # Pallas kernel (ops/fused_augment
+                                         # .py) — uint8 convert + crop +
+                                         # flip + jitter + grayscale in one
+                                         # VMEM round trip per image, blur
+                                         # as an MXU conv on its output;
+                                         # randomness still drawn from the
+                                         # augment_keys stream outside the
+                                         # kernel.  False traces the exact
+                                         # unfused graph (HLO identity
+                                         # pinned by tests/
+                                         # test_fused_augment.py)
     image_size: int = 0                  # augment target size (= model input
                                          # H); required when augment_in_step
     color_jitter_strength: float = 1.0   # augment strength (step placement)
@@ -273,6 +286,14 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
     ``mesh`` (the kernel runs under shard_map; GSPMD cannot partition a
     pallas_call).  False leaves the traced graph byte-identical to the
     pre-fused-update step.
+
+    ``scfg.fused_augment`` swaps the in-step two-view augmentation
+    (``augment_in_step``) for the fused Pallas kernel
+    (ops/fused_augment.py) inside the same accumulation scan — identical
+    ``augment_keys`` stream, views matching ``device_augment.two_view``
+    to fp32 tolerance, shard-local over ``mesh``'s data axis when it
+    spans several devices.  False traces the unfused augmentation graph
+    byte-identically.
     """
     if scfg.accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {scfg.accum_steps}")
@@ -307,6 +328,20 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
             raise ValueError(
                 "fused_update=True requires lr_schedule (the schedule tx "
                 "closes over; the fused kernel needs the bare lr value)")
+    if scfg.fused_augment:
+        # config resolve() rejects these at the CLI; re-checked for
+        # programmatic callers handing a StepConfig straight to the builder
+        if not scfg.augment_in_step:
+            raise ValueError(
+                "fused_augment=True requires augment_in_step=True: the "
+                "kernel fuses the IN-STEP augmentation path (raw uint8 "
+                "batches); loader placement has no in-step chain to fuse")
+        if scfg.accum_bn_mode == "global" and scfg.accum_steps > 1:
+            raise ValueError(
+                "fused_augment=True with accum_bn_mode='global': the "
+                "global oracle vmaps microbatches, and a pallas_call/"
+                "shard_map cannot run under that vmap — use 'average' or "
+                "'microbatch'")
 
     def micro_grads(params, target_params, batch_stats, view1, view2,
                     labels):
@@ -373,9 +408,22 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
         pixels augmented HERE — inside the accumulation scan, so only this
         microbatch's float32 views are ever live — under step placement."""
         if scfg.augment_in_step:
-            v1, v2 = device_augment.two_view(
-                xs["key"], xs["images"], scfg.image_size,
-                strength=scfg.color_jitter_strength)
+            if scfg.fused_augment:
+                # Fused augmentation kernel (ops/fused_augment.py): the
+                # SAME keys and augmentation distribution, but the per-
+                # view op chain collapses into one Pallas pass per image
+                # (uint8 convert + crop + flip + jitter + grayscale) with
+                # the blur conv on its output — shard-local over the data
+                # axis on a multi-device mesh (GSPMD cannot partition a
+                # pallas_call).
+                from byol_tpu.ops import fused_augment as fused_aug_lib
+                v1, v2 = fused_aug_lib.fused_two_view(
+                    xs["key"], xs["images"], scfg.image_size,
+                    strength=scfg.color_jitter_strength, mesh=mesh)
+            else:
+                v1, v2 = device_augment.two_view(
+                    xs["key"], xs["images"], scfg.image_size,
+                    strength=scfg.color_jitter_strength)
             return v1, v2, xs["label"]
         return xs["view1"], xs["view2"], xs["label"]
 

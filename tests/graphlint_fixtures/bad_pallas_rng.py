@@ -1,0 +1,43 @@
+"""GL111 must fire: jax.random.* inside a Pallas kernel body.
+
+The uniform draw below only "works" under interpret= — threefry has no
+Mosaic lowering, so CPU tier-1 would pass while the TPU build breaks.
+The helper indirection must not hide it: the rule closes over bare-name
+calls from the kernel body.
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _noise(shape):
+    return jax.random.uniform(jax.random.PRNGKey(0), shape)   # in-kernel!
+
+
+def _jitter_kernel(x_ref, o_ref):
+    x = x_ref[...]
+    o_ref[...] = x + _noise(x.shape)
+
+
+def jitter(x, interpret=False):
+    return pl.pallas_call(
+        _jitter_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x)
+
+
+def _seeded_kernel(x_ref, o_ref, *, scale):
+    o_ref[...] = x_ref[...] * scale + _noise(x_ref.shape)   # in-kernel!
+
+
+def jitter_partial(x, interpret=False):
+    # the partial-bound spelling (ops/fused_augment.py shape): the rule
+    # must resolve `kernel = functools.partial(fn, ...)` too
+    import functools
+    kernel = functools.partial(_seeded_kernel, scale=2.0)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x)

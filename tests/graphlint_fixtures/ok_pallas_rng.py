@@ -1,0 +1,23 @@
+"""GL111 near-miss: randomness drawn OUTSIDE the pallas_call.
+
+The in-tree contract (ops/fused_augment.py): stochastic parameters come
+from the key stream on the host side of the call and reach the kernel as
+operands — the kernel body is a deterministic function of its inputs.
+The jax.random.* calls in the WRAPPER must not fire the rule.
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _jitter_kernel(x_ref, n_ref, o_ref):
+    o_ref[...] = x_ref[...] + n_ref[...]          # noise is an operand
+
+
+def jitter(key, x, interpret=False):
+    noise = jax.random.uniform(key, x.shape)      # outside the kernel: ok
+    return pl.pallas_call(
+        _jitter_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x, noise)

@@ -47,6 +47,9 @@ RULE_CASES = [
     # lenient json writers emit bare NaN tokens strict parsers reject —
     # the PR 6 run-log lesson as a rule (ISSUE 13 satellite)
     ("GL110", "bad_json_nan.py", "ok_json_nan.py"),
+    # host-RNG primitives have no in-kernel lowering; randomness must be
+    # drawn outside the pallas_call (ISSUE 14 satellite)
+    ("GL111", "bad_pallas_rng.py", "ok_pallas_rng.py"),
 ]
 
 
@@ -127,6 +130,40 @@ class TestPallasLocationArm:
             "        _k, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),\n"
             "        **kw)(x)\n")
         assert run_rule(mod, "GL109") == []
+
+
+class TestPallasRngPartialBinding:
+    """GL111 must resolve the `kernel = functools.partial(fn, ...);
+    pl.pallas_call(kernel, ...)` spelling — the shape the FLAGSHIP
+    in-tree kernel (ops/fused_augment.py) uses.  Isolated here (no other
+    kernel putting the callee in scope), so a regression in the
+    partial-binding resolution fails THIS test, not just the corpus."""
+
+    TEMPLATE = ("import functools\n\n"
+                "import jax\n"
+                "from jax.experimental import pallas as pl\n\n\n"
+                "def _k(x_ref, o_ref, *, scale):\n"
+                "    o_ref[...] = x_ref[...] * scale{body}\n\n\n"
+                "def f(x, interpret=False):\n"
+                "    kernel = functools.partial(_k, scale=2.0)\n"
+                "    return pl.pallas_call(\n"
+                "        kernel,\n"
+                "        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),\n"
+                "        interpret=interpret,\n"
+                "    )(x)\n")
+
+    def test_partial_bound_kernel_with_rng_fires(self, tmp_path):
+        mod = tmp_path / "partial_rng.py"
+        mod.write_text(self.TEMPLATE.format(
+            body=" + jax.random.uniform(jax.random.PRNGKey(0),"
+                 " x_ref.shape)"))
+        findings = run_rule(mod, "GL111")
+        assert findings and "Pallas kernel body" in findings[0].message
+
+    def test_partial_bound_kernel_without_rng_is_clean(self, tmp_path):
+        mod = tmp_path / "partial_clean.py"
+        mod.write_text(self.TEMPLATE.format(body=""))
+        assert run_rule(mod, "GL111") == []
 
 
 class TestEngineSemantics:

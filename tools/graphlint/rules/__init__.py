@@ -10,6 +10,7 @@ from tools.graphlint.rules.donate import DonateRule
 from tools.graphlint.rules.host_sync import HostSyncRule
 from tools.graphlint.rules.json_nan import JsonNanRule
 from tools.graphlint.rules.pallas_interpret import PallasInterpretRule
+from tools.graphlint.rules.pallas_rng import PallasRngRule
 from tools.graphlint.rules.prng import PRNGReuseRule
 from tools.graphlint.rules.recompile import RecompileRule
 from tools.graphlint.rules.remat_tags import RematTagRule
@@ -20,4 +21,4 @@ def all_rules() -> List[Rule]:
     return [HostSyncRule(), RecompileRule(), PRNGReuseRule(),
             DonateRule(), RematTagRule(), CliDriftRule(),
             ShardingAxesRule(), CollectiveAxesRule(),
-            PallasInterpretRule(), JsonNanRule()]
+            PallasInterpretRule(), JsonNanRule(), PallasRngRule()]

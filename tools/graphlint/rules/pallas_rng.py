@@ -1,0 +1,143 @@
+"""GL111 — ``jax.random.*`` inside a Pallas kernel body.
+
+Host-RNG primitives do not exist inside a Pallas kernel: ``jax.random``
+keys and samplers are traced XLA ops, and a kernel body lowers through
+Mosaic (or the interpreter), where ``threefry2x32`` has no lowering — the
+call either fails to compile on TPU or, worse, silently works ONLY under
+``interpret=`` so CPU tier-1 passes while the TPU build is broken.  The
+in-tree contract (ops/fused_augment.py, the module this rule was written
+alongside): every stochastic parameter is drawn OUTSIDE the
+``pallas_call`` from the run's key stream and handed to the kernel as an
+operand, so the kernel body is a deterministic function of its inputs.
+(Pallas does ship its own in-kernel PRNG — ``pltpu.prng_seed`` /
+``prng_random_bits`` — which this rule deliberately does not flag; it is
+the supported spelling when in-kernel randomness is genuinely needed.)
+
+Detection is module-local and resolution-based (the GL109
+zero-false-positive contract):
+
+- a **kernel body** is any module-local ``def`` passed (bare, through
+  ``functools.partial``, or through a simple ``name =
+  functools.partial(fn, ...)`` binding — the ops/fused_augment.py
+  spelling) as the kernel argument of a call resolving to
+  ``pallas_call``, closed over bare-name calls to other module-local defs
+  (a kernel delegating its math to a helper keeps the helper in scope);
+- inside those scopes, any call resolving to ``jax.random.*`` is flagged;
+- kernels referenced any other way (attribute lookups, ``**kwargs``)
+  cannot be resolved statically and stand down.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from tools.graphlint.astutil import FuncNode, qualname
+from tools.graphlint.engine import Context, Finding, LintedFile, Rule
+
+_RANDOM_PREFIX = "jax.random."
+
+
+def _is_pallas_call(node: ast.Call, f: LintedFile) -> bool:
+    q = qualname(node.func, f.imports)
+    return bool(q) and (q == "pallas_call" or q.endswith(".pallas_call"))
+
+
+def _unwrap_partial(node: ast.AST | None, f: LintedFile) -> ast.AST | None:
+    if (isinstance(node, ast.Call)
+            and qualname(node.func, f.imports) == "functools.partial"
+            and node.args):
+        return node.args[0]
+    return node
+
+
+def _partial_bindings(f: LintedFile) -> Dict[str, str]:
+    """Simple ``name = functools.partial(fn, ...)`` assignments anywhere
+    in the module: name -> fn (the ops/fused_augment.py spelling, where
+    the bound kernel is built a few lines above the pallas_call)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(f.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        fn = _unwrap_partial(node.value, f)
+        if fn is not node.value and isinstance(fn, ast.Name):
+            out[node.targets[0].id] = fn.id
+    return out
+
+
+def _kernel_arg(node: ast.Call, f: LintedFile) -> ast.AST | None:
+    """The kernel argument of a pallas_call: first positional or the
+    ``kernel=`` keyword, unwrapped from ``functools.partial(fn, ...)``."""
+    cand = None
+    if node.args:
+        cand = node.args[0]
+    else:
+        for kw in node.keywords:
+            if kw.arg == "kernel":
+                cand = kw.value
+    return _unwrap_partial(cand, f)
+
+
+class PallasRngRule(Rule):
+    id = "GL111"
+    name = "pallas-kernel-host-rng"
+    doc = ("jax.random.* inside a Pallas kernel body has no Mosaic "
+           "lowering — draw randomness outside the pallas_call and pass "
+           "it as an operand (ops/fused_augment.py is the pattern)")
+
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+
+        # kernel bodies: defs/lambdas handed to a pallas_call
+        partials = _partial_bindings(f)
+        kernels: Set[ast.AST] = set()
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call) or not _is_pallas_call(node,
+                                                                     f):
+                continue
+            arg = _kernel_arg(node, f)
+            if isinstance(arg, ast.Lambda):
+                kernels.add(arg)
+            elif isinstance(arg, ast.Name):
+                name = partials.get(arg.id, arg.id)
+                kernels.update(by_name.get(name, ()))
+            # attribute refs / **kwargs: unresolvable, stand down
+
+        # close over module-local helpers a kernel body calls by bare name
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(kernels):
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Name)):
+                        for callee in by_name.get(node.func.id, ()):
+                            if callee not in kernels:
+                                kernels.add(callee)
+                                changed = True
+
+        seen: Set[ast.AST] = set()
+        for fn in kernels:
+            for node in ast.walk(fn):
+                if (isinstance(node, FuncNode) and node is not fn
+                        and node in kernels):
+                    continue  # reported under its own kernel-scope entry
+                if not isinstance(node, ast.Call) or node in seen:
+                    continue
+                q = qualname(node.func, f.imports)
+                if q and (q.startswith(_RANDOM_PREFIX)
+                          or _RANDOM_PREFIX in q):
+                    seen.add(node)
+                    findings.append(self.finding(
+                        f, node, f"{q} inside a Pallas kernel body — "
+                        "host-RNG primitives have no in-kernel lowering "
+                        "(the call only 'works' under interpret=, so CPU "
+                        "tier-1 passes while the TPU build breaks); draw "
+                        "the randomness outside the pallas_call and pass "
+                        "it as an operand, or use the pltpu in-kernel "
+                        "PRNG"))
+        return findings
