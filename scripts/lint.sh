@@ -1,8 +1,13 @@
 #!/usr/bin/env bash
-# Static-analysis gate: graphlint over the shipped byol_tpu/ tree.
+# Static-analysis gate: graphlint over the shipped byol_tpu/ tree AND
+# over tools/graphlint/ itself (self-hosting, ISSUE 17: the linter must
+# hold to its own rules — GL103 name hygiene, GL110 strict JSON, ...).
 #
 # Default run (no args) produces both outputs from ONE engine run:
-#   - human text on stdout (findings as path:line:col: RULE message);
+#   - human text on stdout (findings as path:line:col: RULE message),
+#     ending with the schema-v3 timing footer — total wall time + the
+#     slowest rules, incl. the shared whole-program "project-resolution"
+#     pass — so the cross-module layer can't silently blow up lint time;
 #   - machine JSON at evidence/graphlint.json (schema in
 #     tools/graphlint/reporters.py), committed so rule-count trends are
 #     diffable across PRs.
@@ -29,8 +34,8 @@ export JAX_PLATFORMS=cpu
 
 if [ "$#" -eq 0 ]; then
     mkdir -p evidence
-    exec python -m tools.graphlint byol_tpu/ \
+    exec python -m tools.graphlint byol_tpu/ tools/graphlint/ \
         --trend-baseline evidence/graphlint.json \
         --out evidence/graphlint.json
 fi
-exec python -m tools.graphlint byol_tpu/ "$@"
+exec python -m tools.graphlint byol_tpu/ tools/graphlint/ "$@"

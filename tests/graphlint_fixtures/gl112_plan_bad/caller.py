@@ -1,0 +1,27 @@
+"""Fixture: call sites that bypass / disagree with the imported plan —
+the GL112 arms that live OUTSIDE the plan module."""
+import jax
+
+from .compile_plan import Plan
+
+
+def train_step(state, batch):
+    return state, batch
+
+
+plan = Plan()
+wired = plan.jit_train_step(train_step, None)
+used_eval = plan.jit_eval_step(train_step)
+
+# GL112-bypass: donation agrees with DONATE["train_step"] but the entry
+# is jitted here with inline shardings instead of through the builder
+bypassed = jax.jit(train_step,
+                   in_shardings=(None, None),
+                   donate_argnums=(0,))
+
+# GL112-mismatch: inline sharding kwarg present and the donation (none)
+# disagrees with the declared (0,)
+undonated = jax.jit(train_step, out_shardings=None)
+
+# GL112-donate-undeclared: donates argument 1, which DONATE never declares
+overdonated = jax.jit(train_step, donate_argnums=(0, 1))

@@ -1,0 +1,29 @@
+"""Fixture: a compile plan whose wiring DISAGREES with its own DONATE
+declaration — every GL112 arm that lives inside the plan module.
+
+`legacy_probe_step` is declared but no jit_legacy_probe_step call site
+exists anywhere -> GL112-unused-entry.
+"""
+import jax
+
+DONATE = {
+    "train_step": (0,),
+    "eval_step": (),
+    "legacy_probe_step": (0,),      # GL112-unused-entry: nobody calls it
+}
+
+
+class Plan:
+    def jit_train_step(self, fn, state_sharding):
+        # GL112-donate-undeclared: donates argument 1 on top of the
+        # declared (0,)
+        return jax.jit(fn,
+                       in_shardings=(state_sharding, None),
+                       donate_argnums=(0, 1))
+
+    def jit_eval_step(self, fn):
+        # GL112-mismatch: wires ANOTHER entry's declaration
+        return jax.jit(fn, donate_argnums=DONATE["train_step"])
+
+    def jit_legacy_probe_step(self, fn):
+        return jax.jit(fn, donate_argnums=DONATE["legacy_probe_step"])

@@ -1,0 +1,26 @@
+"""Fixture near-miss: every entry is used through its builder; the one
+inline jit stages a NON-entry helper, which is no business of the plan's."""
+import jax
+
+from .compile_plan import Plan
+
+
+def train_step(state, batch):
+    return state, batch
+
+
+def eval_step(state, batch):
+    return batch
+
+
+def _preprocess(batch):
+    return batch
+
+
+plan = Plan()
+step = plan.jit_train_step(train_step, None)
+evaluate = plan.jit_eval_step(eval_step, None)
+
+# not a plan entry: per-site wiring of private helpers is GL107's beat,
+# not a plan-contract violation
+prep = jax.jit(_preprocess, donate_argnums=(0,))

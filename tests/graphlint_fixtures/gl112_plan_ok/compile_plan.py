@@ -1,0 +1,20 @@
+"""Fixture near-miss: a plan whose builders wire exactly what DONATE
+declares (the shipped parallel/compile_plan.py shape) — GL112 must stay
+silent."""
+import jax
+
+DONATE = {
+    "train_step": (0,),
+    "eval_step": (),
+}
+
+
+class Plan:
+    def jit_train_step(self, fn, state_sharding):
+        return jax.jit(fn,
+                       in_shardings=(state_sharding, None),
+                       out_shardings=(state_sharding, None),
+                       donate_argnums=DONATE["train_step"])
+
+    def jit_eval_step(self, fn, state_sharding):
+        return jax.jit(fn, in_shardings=(state_sharding, None))

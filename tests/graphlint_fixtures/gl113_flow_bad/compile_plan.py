@@ -1,0 +1,13 @@
+"""Fixture: a well-formed plan (GL112-clean) whose train entry donates
+arg 0 — the donation GL113's flow analysis must see through the builder
+indirection."""
+import jax
+
+DONATE = {
+    "train_step": (0,),
+}
+
+
+class Plan:
+    def jit_train_step(self, fn):
+        return jax.jit(fn, donate_argnums=DONATE["train_step"])

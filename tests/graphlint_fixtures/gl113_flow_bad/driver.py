@@ -1,0 +1,12 @@
+"""Fixture: the driver module — imports the donor binding from wiring.py
+and re-donates the same state every loop iteration without rebinding
+(the canonical cross-module use-after-donate)."""
+from .wiring import train_step
+
+
+def train(state, batches):
+    history = []
+    for batch in batches:
+        new_state, metrics = train_step(state, batch)  # GL113 on pass 2
+        history.append(metrics)
+    return new_state, history

@@ -1,0 +1,17 @@
+"""Fixture: the wiring module — binds the donating entry point at module
+level, local use-after-donate included."""
+from .compile_plan import Plan
+
+plan = Plan()
+
+
+def _step(state, batch):
+    return state, batch
+
+
+train_step = plan.jit_train_step(_step)
+
+
+def local_reuse(state, batch):
+    new_state, metrics = train_step(state, batch)
+    return new_state, metrics, state    # GL113: `state` was donated

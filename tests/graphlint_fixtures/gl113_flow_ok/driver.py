@@ -1,0 +1,18 @@
+"""Fixture near-miss driver: the loop rebinds the donated state every
+iteration, and the NON-donating eval entry may reuse its inputs freely."""
+from .wiring import eval_step, train_step
+
+
+def train(state, batches):
+    history = []
+    for batch in batches:
+        state, metrics = train_step(state, batch)
+        history.append(metrics)
+    return state, history
+
+
+def evaluate(state, batches):
+    out = []
+    for batch in batches:
+        out.append(eval_step(state, batch))   # state read-only: no donation
+    return state, out

@@ -1,0 +1,19 @@
+"""Fixture: the step IMPLEMENTATION — clean module-locally (nothing here
+jits anything), but jit_site.py jits `step_impl`, so wave-3 propagation
+must mark this def traced and fire GL101 at these lines with the jit
+site named."""
+import time
+
+import numpy as np
+
+
+def _metrics(y):
+    # reached transitively from the traced def: also in traced scope
+    return np.mean(y)
+
+
+def step_impl(state, batch):
+    t0 = time.perf_counter()          # GL101: host clock under the trace
+    y = np.asarray(batch)             # GL101: host materialization
+    m = _metrics(y)                   # GL101 fires inside _metrics too
+    return state, (m, t0)

@@ -1,0 +1,8 @@
+"""Fixture: the JIT SITE — module A jits a function imported from module
+B (impl.py).  No finding lands in this file; the findings land at the
+definition site in impl.py, carrying this file's jit line."""
+import jax
+
+from .impl import step_impl
+
+train_step = jax.jit(step_impl)

@@ -1,0 +1,17 @@
+"""Fixture near-miss: same two-module shape as xmod_host_sync_bad, but
+the imported def is trace-clean (jnp only) and the host clock lives in a
+helper that is NOT reachable from the traced def — cross-module
+propagation must not over-mark."""
+import time
+
+import jax.numpy as jnp
+
+
+def wall_clock():
+    # host clock, but only ever called from untraced dispatch code
+    return time.perf_counter()
+
+
+def step_impl(state, batch):
+    y = jnp.asarray(batch)
+    return state, jnp.mean(y)

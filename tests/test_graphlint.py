@@ -50,11 +50,17 @@ RULE_CASES = [
     # host-RNG primitives have no in-kernel lowering; randomness must be
     # drawn outside the pallas_call (ISSUE 14 satellite)
     ("GL111", "bad_pallas_rng.py", "ok_pallas_rng.py"),
+    # wave 3 (ISSUE 17): multi-file fixture PACKAGES — cross-module traced
+    # scope (jit in one file, host sync in the imported callee), the
+    # compile-plan contract, and cross-module donation flow
+    ("GL101", "xmod_host_sync_bad", "xmod_host_sync_ok"),
+    ("GL112", "gl112_plan_bad", "gl112_plan_ok"),
+    ("GL113", "gl113_flow_bad", "gl113_flow_ok"),
 ]
 
 
 def run_rule(path, rule_id):
-    findings, _ = engine.run([str(path)], all_rules(), select={rule_id})
+    findings, _, _ = engine.run([str(path)], all_rules(), select={rule_id})
     return [f for f in findings if f.rule == rule_id]
 
 
@@ -175,7 +181,7 @@ class TestEngineSemantics:
                "    return a + b\n")
         p = tmp_path / "sup.py"
         p.write_text(src)
-        findings, _ = engine.run([str(p)], all_rules())
+        findings, _, _ = engine.run([str(p)], all_rules())
         assert findings == []
 
     def test_unjustified_suppression_is_gl001(self, tmp_path):
@@ -186,7 +192,7 @@ class TestEngineSemantics:
                "    return a + b\n")
         p = tmp_path / "sup.py"
         p.write_text(src)
-        findings, _ = engine.run([str(p)], all_rules())
+        findings, _, _ = engine.run([str(p)], all_rules())
         assert [f.rule for f in findings] == [engine.UNJUSTIFIED]
 
     def test_suppression_on_comment_line_covers_next_line(self, tmp_path):
@@ -197,7 +203,7 @@ class TestEngineSemantics:
                "    return a + b\n")
         p = tmp_path / "sup.py"
         p.write_text(src)
-        findings, _ = engine.run([str(p)], all_rules())
+        findings, _, _ = engine.run([str(p)], all_rules())
         assert findings == []
 
     def test_suppression_covers_only_named_rule(self, tmp_path):
@@ -208,7 +214,7 @@ class TestEngineSemantics:
                "    return a + b\n")
         p = tmp_path / "sup.py"
         p.write_text(src)
-        findings, _ = engine.run([str(p)], all_rules())
+        findings, _, _ = engine.run([str(p)], all_rules())
         assert "GL103" in {f.rule for f in findings}
 
     def test_suppression_text_inside_string_is_inert(self, tmp_path):
@@ -225,7 +231,7 @@ class TestEngineSemantics:
                "    return a + b, msg\n")
         p = tmp_path / "doc.py"
         p.write_text(src)
-        findings, _ = engine.run([str(p)], all_rules())
+        findings, _, _ = engine.run([str(p)], all_rules())
         rules = [f.rule for f in findings]
         assert engine.UNJUSTIFIED not in rules     # docstring: no phantom
         assert "GL103" in rules                    # string didn't suppress
@@ -247,7 +253,7 @@ class TestEngineSemantics:
             "class Block:\n"                 # unrelated, never wrapped
             "    def render(self):\n"
             "        return 'html'\n")
-        findings, _ = engine.run(
+        findings, _, _ = engine.run(
             [str(tmp_path / "a.py"), str(tmp_path / "b.py")],
             all_rules(), select={"GL105"})
         assert findings == [], [f.message for f in findings]
@@ -255,19 +261,30 @@ class TestEngineSemantics:
     def test_syntax_error_is_gl000(self, tmp_path):
         p = tmp_path / "broken.py"
         p.write_text("def f(:\n")
-        findings, _ = engine.run([str(p)], all_rules())
+        findings, _, _ = engine.run([str(p)], all_rules())
         assert [f.rule for f in findings] == [engine.PARSE_ERROR]
 
     def test_json_reporter_shape(self, tmp_path):
         p = tmp_path / "clean.py"
         p.write_text("x = 1\n")
-        findings, files = engine.run([str(p)], all_rules())
-        payload = json.loads(json_report(findings, files, [str(p)]))
+        findings, files, stats = engine.run([str(p)], all_rules())
+        payload = json.loads(json_report(findings, files, [str(p)], stats))
         assert payload["clean"] is True
         assert payload["files_scanned"] == 1
         assert payload["findings"] == []
-        assert payload["schema_version"] == 2
+        assert payload["schema_version"] == 3
         assert payload["suppressions_by_rule"] == {}
+        # schema v3: per-rule wall time (incl. the shared whole-program
+        # pass under its own key) + resolution counters
+        timing = payload["timing"]
+        assert engine.PROJECT_PASS in timing["rule_wall_seconds"]
+        assert all(sec >= 0 for sec in timing["rule_wall_seconds"].values())
+        assert timing["total_seconds"] >= 0
+        res = payload["resolution"]
+        for field in ("files_indexed", "modules_indexed",
+                      "symbols_resolved", "symbols_unresolved",
+                      "cross_module_traced"):
+            assert isinstance(res[field], int)
 
     def test_out_json_with_text_stdout(self, tmp_path):
         """One run, both reports: text on stdout, JSON at --out *.json —
@@ -283,6 +300,136 @@ class TestEngineSemantics:
         assert "finding(s) in 1 file(s) scanned" in proc.stdout  # text
         payload = json.loads(out.read_text())                    # json
         assert payload["clean"] is True
+
+
+class TestWholeProgram:
+    """Wave 3 (ISSUE 17) acceptance: cross-module traced scope and the
+    compile-plan contract, asserted at the finding level (the corpus
+    tests only assert fire/stay-silent)."""
+
+    def test_gl101_fires_at_definition_with_jit_site_named(self):
+        """Module A jits a function imported from module B: GL101 must
+        land in B (impl.py) — NOT in A — and carry A's jit site."""
+        findings = run_rule(FIXTURES / "xmod_host_sync_bad", "GL101")
+        assert findings, "cross-module traced scope did not propagate"
+        assert all(f.path.endswith("impl.py") for f in findings), (
+            [f.path for f in findings])
+        assert any("jax.jit at" in f.message
+                   and "jit_site.py:8" in f.message for f in findings), (
+            [f.message for f in findings])
+
+    def test_gl101_transitive_callee_is_traced(self):
+        """The traced def's module-local callee (_metrics) is in traced
+        scope too — the closure, not just the entry def."""
+        findings = run_rule(FIXTURES / "xmod_host_sync_bad", "GL101")
+        lines = {f.line for f in findings}
+        assert 12 in lines, (  # np.mean inside _metrics
+            f"no finding inside the transitive callee: {sorted(lines)}")
+
+    def test_gl111_resolves_imported_kernel(self, tmp_path):
+        """A pallas_call staging a kernel imported from another module
+        flags the RNG at the kernel's definition site, naming the
+        staging site."""
+        (tmp_path / "kern.py").write_text(
+            "import jax\n\n\n"
+            "def noisy_kernel(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...] + jax.random.uniform(\n"
+            "        jax.random.PRNGKey(0), x_ref.shape)\n")
+        (tmp_path / "call.py").write_text(
+            "import jax\n"
+            "from jax.experimental import pallas as pl\n\n"
+            "from kern import noisy_kernel\n\n\n"
+            "def f(x):\n"
+            "    return pl.pallas_call(\n"
+            "        noisy_kernel,\n"
+            "        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),\n"
+            "    )(x)\n")
+        findings = run_rule(tmp_path, "GL111")
+        assert findings, "imported kernel did not resolve"
+        assert all(f.path.endswith("kern.py") for f in findings)
+        assert any("kernel staged via pallas_call" in f.message
+                   for f in findings)
+
+    def test_gl112_all_arms_fire_with_distinct_codes(self):
+        findings = run_rule(FIXTURES / "gl112_plan_bad", "GL112")
+        tags = {m for f in findings
+                for m in ("GL112-bypass", "GL112-mismatch",
+                          "GL112-donate-undeclared", "GL112-unused-entry")
+                if f"[{m}]" in f.message}
+        assert tags == {"GL112-bypass", "GL112-mismatch",
+                        "GL112-donate-undeclared", "GL112-unused-entry"}, (
+            f"arms missing: {[f.message for f in findings]}")
+
+    def test_gl112_site_mismatch_and_undeclared_donation(self):
+        """The acceptance pair: a per-site donation kwarg disagreeing
+        with the plan, and a donated-but-undeclared argument — both at
+        call sites OUTSIDE the plan module."""
+        findings = run_rule(FIXTURES / "gl112_plan_bad", "GL112")
+        caller = [f for f in findings if f.path.endswith("caller.py")]
+        assert any("[GL112-mismatch]" in f.message for f in caller)
+        assert any("[GL112-donate-undeclared]" in f.message for f in caller)
+
+    def test_gl112_unused_entry_stands_down_without_call_sites(self):
+        """Linting the plan file ALONE: no builder call sites exist in
+        the selection, so unused-entry is a property of the selection,
+        not the program — it must stand down."""
+        findings = run_rule(
+            FIXTURES / "gl112_plan_bad" / "compile_plan.py", "GL112")
+        assert not any("[GL112-unused-entry]" in f.message
+                       for f in findings), [f.message for f in findings]
+
+    def test_gl113_cross_module_donor_names_binding_site(self):
+        """driver.py imports the donor from wiring.py: the loop reuse
+        fires in driver.py with the wiring.py binding line named."""
+        findings = run_rule(FIXTURES / "gl113_flow_bad", "GL113")
+        driver = [f for f in findings if f.path.endswith("driver.py")]
+        assert driver, [f.path for f in findings]
+        assert any("wiring.py:12" in f.message for f in driver), (
+            [f.message for f in driver])
+        assert any("'train_step'" in f.message for f in driver)
+
+    def test_gl113_local_reuse_fires(self):
+        findings = run_rule(FIXTURES / "gl113_flow_bad", "GL113")
+        assert any(f.path.endswith("wiring.py") for f in findings)
+
+    def test_gl113_needs_no_gl104_donor(self):
+        """GL104 stays silent on the plan-builder donors (no literal
+        jax.jit assignment in scope) — the gap GL113 exists to close."""
+        findings = run_rule(FIXTURES / "gl113_flow_bad", "GL104")
+        assert findings == [], [f.message for f in findings]
+
+    def test_ok_fixture_plans_clean_under_full_rule_set(self):
+        """GL107's plan-module exemption is structural (any compile_plan.py
+        with a static DONATE — GL112's plan_registry), so a fixture plan
+        is never told to move its shardings into the canonical plan: the
+        ok packages must be clean under EVERY rule, not just their own."""
+        for pkg in ("gl112_plan_ok", "gl113_flow_ok", "xmod_host_sync_ok"):
+            findings, _, _ = engine.run([str(FIXTURES / pkg)], all_rules())
+            assert findings == [], (pkg, [f.message for f in findings])
+
+    def test_unresolvable_import_stands_down(self, tmp_path):
+        """jitting a function imported from OUTSIDE the lint root must
+        not guess: no cross-module findings, counted as unresolved."""
+        (tmp_path / "site.py").write_text(
+            "import jax\n"
+            "from somewhere_else import impl_fn\n\n"
+            "fast = jax.jit(impl_fn)\n")
+        findings, _, stats = engine.run([str(tmp_path)], all_rules(),
+                                        select={"GL101"})
+        assert findings == []
+        assert stats.resolution["cross_module_traced"] == 0
+
+    def test_text_report_prints_slowest_rules(self):
+        """scripts/lint.sh surfaces the slowest rules from this footer —
+        the guard that keeps the whole-program pass honest about cost."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graphlint",
+             "tools/graphlint/astutil.py"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "slowest:" in proc.stdout
+        assert engine.PROJECT_PASS in proc.stdout
+        assert "resolution:" in proc.stdout
 
 
 class TestTrendAlarm:
@@ -368,6 +515,15 @@ class TestTreeGate:
             capture_output=True, text=True, cwd=REPO)
         assert proc.returncode == 0, (
             "graphlint found new issues in byol_tpu/:\n" + proc.stdout)
+
+    def test_linter_lints_itself_clean(self):
+        """Self-hosting (ISSUE 17): tools/graphlint/ passes its own sweep
+        — scripts/lint.sh runs both roots by default."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graphlint", "tools/graphlint/"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, (
+            "graphlint found issues in itself:\n" + proc.stdout)
 
     def test_list_rules_catalog(self):
         proc = subprocess.run(

@@ -7,10 +7,13 @@ Three building blocks every JAX-aware rule needs:
   spelled its imports, so rules match on canonical dotted paths;
 - **traced-scope detection** (:func:`traced_functions`): which function
   bodies end up inside ``jax.jit`` / ``lax.scan`` / ``vmap`` / flax
-  ``__call__`` traces.  This is a *module-local, syntactic* approximation —
-  a function jitted from another module is invisible — which is exactly why
-  the tier-1 runtime guards (``jax.transfer_guard`` + tracer-leak checks)
-  exist alongside the static rules;
+  ``__call__`` traces.  This layer is *module-local and syntactic*; the
+  whole-program layer (tools/graphlint/project.py, wave 3) builds on it
+  to propagate traced scope across modules — a function jitted in one
+  file but defined in another is analyzed as traced at its definition
+  site, with the jit site named in the finding.  The tier-1 runtime
+  guards (``jax.transfer_guard`` + tracer-leak checks) still exist
+  alongside, for everything static resolution stands down on;
 - **expression classification** (:class:`ExprClassifier`): STATIC (shape /
   dtype / python-scalar arithmetic, safe to ``float()``), ARRAY (provably a
   jax value), or UNKNOWN.  Rules flag ARRAY aggressively and UNKNOWN only

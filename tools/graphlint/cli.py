@@ -69,14 +69,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.select:
         select = {s.strip() for s in args.select.split(",") if s.strip()}
     try:
-        findings, files = engine.run(args.paths, rules, select=select)
+        findings, files, stats = engine.run(args.paths, rules,
+                                            select=select)
     except FileNotFoundError as e:
         print(f"graphlint: no such path: {e}", file=sys.stderr)
         return 2
     if args.format == "json":
-        report = json_report(findings, files, args.paths)
+        report = json_report(findings, files, args.paths, stats)
     else:
-        report = text_report(findings, files)
+        report = text_report(findings, files, stats)
     print(report, end="" if report.endswith("\n") else "\n")
     alarms: List[str] = []
     if args.trend_baseline:
@@ -104,7 +105,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.out and not alarms:
         # an alarmed run must not rewrite the evidence file: the grown
         # count would become the new baseline and the ratchet would vanish
-        out_report = (json_report(findings, files, args.paths)
+        out_report = (json_report(findings, files, args.paths, stats)
                       if args.out.endswith(".json") else report)
         with open(args.out, "w", encoding="utf-8") as fh:
             fh.write(out_report if out_report.endswith("\n")

@@ -22,6 +22,7 @@ import dataclasses
 import io
 import os
 import re
+import time
 import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -103,11 +104,38 @@ class LintedFile:
 
 
 class Context:
-    """Shared state across the whole lint run (cross-file rule storage)."""
+    """Shared state across the whole lint run (cross-file rule storage).
+
+    ``store`` also carries the lazily-built whole-program layer
+    (tools/graphlint/project.py): the module/symbol index and the
+    cross-module traced-scope map, shared by every rule that needs them
+    so the project pass runs at most once per lint run.
+    """
 
     def __init__(self, files: Sequence[LintedFile]) -> None:
         self.files = files
         self.store: Dict[str, object] = {}
+
+
+# rule_seconds key for the shared whole-program resolution pass (built
+# once, before any rule runs, so its cost is attributed to itself rather
+# than to whichever rule happens to touch it first)
+PROJECT_PASS = "project-resolution"
+
+
+@dataclasses.dataclass
+class RunStats:
+    """Wall-time + resolution accounting for one lint run (report schema
+    v3): per-rule seconds so a slow rule cannot silently blow up lint
+    time, and the cross-module pass's files/symbols-resolved counts."""
+
+    rule_seconds: Dict[str, float]
+    total_seconds: float
+    resolution: Dict[str, int]
+
+    def slowest(self, n: int = 3) -> List[Tuple[str, float]]:
+        return sorted(self.rule_seconds.items(),
+                      key=lambda kv: kv[1], reverse=True)[:n]
 
 
 class Line:
@@ -170,8 +198,9 @@ def load_files(paths: Sequence[str]) -> List[LintedFile]:
 
 def run(paths: Sequence[str], rules: Sequence[Rule],
         select: Optional[Set[str]] = None
-        ) -> Tuple[List[Finding], List[LintedFile]]:
-    """Lint ``paths`` with ``rules``; returns (findings, files)."""
+        ) -> Tuple[List[Finding], List[LintedFile], RunStats]:
+    """Lint ``paths`` with ``rules``; returns (findings, files, stats)."""
+    t_run = time.perf_counter()
     if select:
         rules = [r for r in rules if r.id in select]
     files = load_files(paths)
@@ -184,14 +213,28 @@ def run(paths: Sequence[str], rules: Sequence[Rule],
     parsed = [f for f in files if f.tree is not None]
 
     ctx = Context(parsed)
+    # whole-program layer up front: one timed pass shared by every rule
+    from tools.graphlint import project
+    t0 = time.perf_counter()
+    project.get_index(ctx)
+    project.project_traced(ctx)
+    rule_seconds: Dict[str, float] = {
+        PROJECT_PASS: time.perf_counter() - t0}
+
     for rule in rules:
+        t0 = time.perf_counter()
         for f in parsed:
             rule.collect(f, ctx)
+        rule_seconds[rule.id] = (rule_seconds.get(rule.id, 0.0)
+                                 + time.perf_counter() - t0)
     for rule in rules:
+        t0 = time.perf_counter()
         for f in parsed:
             for fd in rule.check(f, ctx):
                 if not f.suppressed(fd):
                     findings.append(fd)
+        rule_seconds[rule.id] = (rule_seconds.get(rule.id, 0.0)
+                                 + time.perf_counter() - t0)
 
     # unjustified suppressions are findings themselves (GL001)
     for f in parsed:
@@ -206,4 +249,7 @@ def run(paths: Sequence[str], rules: Sequence[Rule],
                 "'-- <one-line reason>'"))
 
     findings = sorted(set(findings), key=Finding.key)
-    return findings, files
+    stats = RunStats(rule_seconds=rule_seconds,
+                     total_seconds=time.perf_counter() - t_run,
+                     resolution=project.resolution_stats(ctx))
+    return findings, files, stats

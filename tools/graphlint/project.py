@@ -1,0 +1,328 @@
+"""Whole-program resolution: module index, import targets, traced scope.
+
+graphlint wave 3 (ISSUE 17).  The per-file engine is deliberately
+syntactic, but the jit wiring is not module-local: the compile plan
+(parallel/compile_plan.py) jits step functions *imported* from
+training/steps.py, and the fused-kernel PRs put the hot code exactly
+where a module-local ``traced_functions`` cannot see it.  This module
+adds the project-wide layer:
+
+- :class:`ProjectIndex` maps every linted file to a dotted module name
+  (derived from its path — the tool still never imports anything) and
+  resolves imported symbols to their defining file + ``def`` node,
+  following plain re-exports a bounded number of hops.  Relative
+  imports are resolved against the importing module's own dotted path,
+  so fixture packages and the shipped tree both work from any lint
+  root.
+- :func:`project_traced` propagates traced scope across modules: when
+  module A ``jax.jit``\\ s / ``shard_map``\\ s / ``pallas_call``\\ s a
+  function imported from module B, B's definition — and its callees,
+  transitively, with cycle and depth guards — is analyzed as traced,
+  carrying a :class:`TraceSite` naming A's jit site so findings read
+  "host sync here, jitted over there".
+
+House rules carried over from the per-file layer: an import that does
+not resolve inside the lint root (third-party, ambiguous suffix,
+dynamic) STANDS DOWN rather than guessing — the zero-false-positive
+contract — and every resolution is counted so the JSON report's
+``resolution`` section shows what the cross-module pass actually did.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graphlint.astutil import (FuncNode, _function_args_of_call,
+                                     TRACING_CALLS, qualname,
+                                     traced_functions)
+
+# Cross-module propagation guard: a traced call chain deeper than this
+# many module hops stops propagating (cycles are cut by the visited set;
+# the depth guard bounds pathological import lattices).
+MAX_CROSS_MODULE_DEPTH = 16
+
+# Re-export chains (``from .steps import fn`` re-exported by __init__)
+# are followed at most this many hops.
+MAX_REEXPORT_HOPS = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSite:
+    """Where a cross-module traced scope was staged from."""
+
+    path: str       # repo-relative path of the jit-site file
+    line: int
+    via: str        # the tracing call, e.g. "jax.jit"
+
+    def describe(self) -> str:
+        return f"{self.via} at {self.path}:{self.line}"
+
+
+def _module_name(rel: str) -> str:
+    """Dotted module name derived from a repo-relative path.  Pure path
+    math — the tool never imports the code under analysis."""
+    p = rel.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    parts = [seg for seg in p.split("/") if seg and seg != "."]
+    return ".".join(parts)
+
+
+class ProjectIndex:
+    """Project-wide module + symbol table over one lint run's files."""
+
+    def __init__(self, files) -> None:
+        self.files = list(files)
+        # dotted module name -> files claiming it (suffix collisions are
+        # possible across fixture trees; resolution demands uniqueness)
+        self.by_module: Dict[str, List[object]] = {}
+        self.module_of: Dict[object, str] = {}
+        # per-file: local name -> absolute dotted import target
+        self.import_targets: Dict[object, Dict[str, str]] = {}
+        # per-file: top-level def name -> FunctionDef nodes
+        self.toplevel_defs: Dict[object, Dict[str, List[ast.AST]]] = {}
+        # per-file: top-level simple-assign name -> Assign node
+        self.toplevel_assigns: Dict[object, Dict[str, ast.Assign]] = {}
+        self.symbols_resolved = 0
+        self.symbols_unresolved = 0
+
+        for f in self.files:
+            mod = _module_name(f.rel)
+            self.module_of[f] = mod
+            self.by_module.setdefault(mod, []).append(f)
+            self.import_targets[f] = self._collect_imports(f, mod)
+            defs: Dict[str, List[ast.AST]] = {}
+            assigns: Dict[str, ast.Assign] = {}
+            for stmt in f.tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs.setdefault(stmt.name, []).append(stmt)
+                elif (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    assigns[stmt.targets[0].id] = stmt
+            self.toplevel_defs[f] = defs
+            self.toplevel_assigns[f] = assigns
+
+    # ------------------------------------------------------------- imports
+    @staticmethod
+    def _collect_imports(f, mod: str) -> Dict[str, str]:
+        """Local name -> absolute dotted target, with ``from . import``
+        relativity resolved against the importing module's own path
+        (ImportMap keeps only the module tail — fine for qualname
+        suffixing, not for project resolution)."""
+        out: Dict[str, str] = {}
+        is_pkg = f.rel.replace("\\", "/").endswith("__init__.py")
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.level:
+                    parts = mod.split(".") if mod else []
+                    if not is_pkg and parts:
+                        parts = parts[:-1]
+                    drop = node.level - 1
+                    if drop:
+                        parts = parts[:-drop] if drop <= len(parts) else []
+                    base = parts + (node.module.split(".")
+                                    if node.module else [])
+                else:
+                    base = node.module.split(".") if node.module else []
+                if not base:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = ".".join(base + [a.name])
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+        return out
+
+    # ---------------------------------------------------------- resolution
+    def _module_file(self, dotted: str):
+        """The unique file for a dotted module path: exact match first,
+        then unique-suffix (the lint root's path prefix is not part of
+        the import spelling).  Ambiguity stands down."""
+        cands = self.by_module.get(dotted, [])
+        if not cands:
+            tail = "." + dotted
+            cands = [f for m, fs in self.by_module.items()
+                     for f in fs if m.endswith(tail)]
+        return cands[0] if len(cands) == 1 else None
+
+    def resolve_symbol(self, dotted: str, _hops: int = 0):
+        """``pkg.mod.fn`` -> (file, FunctionDef) when it names exactly one
+        top-level def inside the lint root; ``None`` stands down."""
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            target = self._module_file(".".join(parts[:i]))
+            if target is None:
+                continue
+            tail = parts[i:]
+            if len(tail) != 1:
+                continue        # Class.method / nested attr: stand down
+            name = tail[0]
+            defs = self.toplevel_defs[target].get(name, [])
+            if len(defs) == 1:
+                self.symbols_resolved += 1
+                return target, defs[0]
+            # plain re-export: the name is itself an import in the target
+            reexport = self.import_targets[target].get(name)
+            if reexport and _hops < MAX_REEXPORT_HOPS:
+                hit = self.resolve_symbol(reexport, _hops + 1)
+                if hit is not None:
+                    return hit
+        self.symbols_unresolved += 1
+        return None
+
+    def resolve_call_target(self, f, node: ast.AST):
+        """Resolve a call-target expression in file ``f`` to the defining
+        (file, FunctionDef) — bare imported names via the import table,
+        dotted references via alias-resolved qualnames."""
+        if isinstance(node, ast.Name):
+            local = self.toplevel_defs[f].get(node.id, [])
+            if len(local) == 1:
+                return f, local[0]
+            target = self.import_targets[f].get(node.id)
+            return self.resolve_symbol(target) if target else None
+        q = qualname(node, f.imports)
+        return self.resolve_symbol(q) if q and "." in q else None
+
+    def resolve_toplevel_assign(self, f, name: str):
+        """An imported NAME -> the module-level ``Assign`` binding it in
+        its defining file (for donation-flow donors bound at module
+        scope), following the import table one level."""
+        target = self.import_targets[f].get(name)
+        if not target:
+            return None
+        parts = target.rsplit(".", 1)
+        if len(parts) != 2:
+            return None
+        mod_file = self._module_file(parts[0])
+        if mod_file is None:
+            return None
+        assign = self.toplevel_assigns[mod_file].get(parts[1])
+        return (mod_file, assign) if assign is not None else None
+
+
+# ---------------------------------------------------------------------------
+# Context-cached builders (rules share one index / one traced map per run)
+
+def get_index(ctx) -> ProjectIndex:
+    idx = ctx.store.get("project_index")
+    if idx is None:
+        idx = ProjectIndex(ctx.files)
+        ctx.store["project_index"] = idx
+    return idx
+
+
+def project_traced(ctx) -> Dict[object, Dict[ast.AST, Optional[TraceSite]]]:
+    """file -> {function node -> None (locally traced) | TraceSite}.
+
+    The local layer is exactly :func:`astutil.traced_functions`; the
+    cross-module layer seeds from tracing calls whose staged function
+    resolves to another module's def and closes transitively over that
+    def's callees — module-local by bare name / ``self.method`` (free),
+    cross-module through the import table (one depth unit per hop).
+    """
+    cached = ctx.store.get("project_traced")
+    if cached is not None:
+        return cached
+    index = get_index(ctx)
+    scope: Dict[object, Dict[ast.AST, Optional[TraceSite]]] = {}
+    for f in ctx.files:
+        scope[f] = {fn: None for fn in traced_functions(f.tree, f.imports)}
+
+    # seed: tracing calls staging a function that resolves cross-module
+    work: List[Tuple[object, ast.AST, TraceSite, int]] = []
+    for f in ctx.files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            via = qualname(node.func, f.imports)
+            if via not in TRACING_CALLS:
+                continue
+            for arg in _function_args_of_call(node, f.imports):
+                if not isinstance(arg, (ast.Name, ast.Attribute)):
+                    continue
+                hit = index.resolve_call_target(f, arg)
+                if hit is None or hit[0] is f:
+                    continue    # local (already covered) or unresolvable
+                site = TraceSite(f.rel, node.lineno, via)
+                work.append((hit[0], hit[1], site, 0))
+
+    visited: Set[Tuple[int, int]] = set()
+    cross_module = 0
+    while work:
+        tf, tdef, site, depth = work.pop()
+        mark = (id(tf), id(tdef))
+        if mark in visited:
+            continue
+        visited.add(mark)
+        if tdef not in scope[tf]:
+            scope[tf][tdef] = site
+            cross_module += 1
+        elif scope[tf][tdef] is None:
+            continue        # locally traced already: local closure covers it
+        # nested defs run under the same trace
+        for sub in ast.walk(tdef):
+            if isinstance(sub, FuncNode) and sub is not tdef:
+                work.append((tf, sub, site, depth))
+        # callees: module-local by bare name / self.method; imported
+        # through the index with the depth guard
+        local_defs = index.toplevel_defs[tf]
+        for node in ast.walk(tdef):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name):
+                for callee in local_defs.get(fn.id, ()):
+                    work.append((tf, callee, site, depth))
+                target = index.import_targets[tf].get(fn.id)
+                if target and depth < MAX_CROSS_MODULE_DEPTH:
+                    hit = index.resolve_symbol(target)
+                    if hit is not None:
+                        work.append((hit[0], hit[1], site, depth + 1))
+            elif isinstance(fn, ast.Attribute):
+                if (isinstance(fn.value, ast.Name)
+                        and fn.value.id == "self"):
+                    # self.method(): methods of the same class — approximate
+                    # with same-file defs of that name, as the local layer
+                    for callee in _defs_named(tf, fn.attr):
+                        work.append((tf, callee, site, depth))
+                elif depth < MAX_CROSS_MODULE_DEPTH:
+                    q = qualname(fn, tf.imports)
+                    if q and "." in q:
+                        hit = index.resolve_symbol(q)
+                        if hit is not None and hit[0] is not tf:
+                            work.append((hit[0], hit[1], site, depth + 1))
+
+    ctx.store["project_traced"] = scope
+    ctx.store["project_traced_cross_module"] = cross_module
+    return scope
+
+
+def _defs_named(f, name: str) -> Iterable[ast.AST]:
+    for node in ast.walk(f.tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == name):
+            yield node
+
+
+def resolution_stats(ctx) -> Dict[str, int]:
+    """The JSON report's ``resolution`` section: what the cross-module
+    pass indexed and resolved (all zero when no rule touched it)."""
+    idx = ctx.store.get("project_index")
+    if idx is None:
+        return {"files_indexed": 0, "modules_indexed": 0,
+                "symbols_resolved": 0, "symbols_unresolved": 0,
+                "cross_module_traced": 0}
+    return {
+        "files_indexed": len(idx.files),
+        "modules_indexed": len(idx.by_module),
+        "symbols_resolved": idx.symbols_resolved,
+        "symbols_unresolved": idx.symbols_unresolved,
+        "cross_module_traced": ctx.store.get("project_traced_cross_module",
+                                             0),
+    }

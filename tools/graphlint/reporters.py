@@ -6,22 +6,38 @@ The JSON shape is stable on purpose — scripts/lint.sh writes it to
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
-from tools.graphlint.engine import Finding, LintedFile
+from tools.graphlint.engine import Finding, LintedFile, RunStats
 
 # v2: + suppressions_by_rule (the trend-alarm input — ROADMAP rule-wave-2
 # item d: CI fails when a rule's suppression count grows vs the committed
 # evidence file)
-SCHEMA_VERSION = 2
+# v3: + timing (per-rule wall seconds, incl. the shared whole-program
+# "project-resolution" pass) and resolution (what the cross-module layer
+# indexed/resolved), so a slow rule or a resolution regression is visible
+# in the committed evidence, not just in CI wall time
+SCHEMA_VERSION = 3
 
 
 def text_report(findings: Sequence[Finding],
-                files: Sequence[LintedFile]) -> str:
+                files: Sequence[LintedFile],
+                stats: Optional[RunStats] = None) -> str:
     lines = [f"{fd.path}:{fd.line}:{fd.col}: {fd.rule} {fd.message}"
              for fd in findings]
     lines.append(f"graphlint: {len(findings)} finding(s) in "
                  f"{len(files)} file(s) scanned")
+    if stats is not None:
+        slow = ", ".join(f"{rule} {sec * 1000:.0f}ms"
+                         for rule, sec in stats.slowest(3))
+        res = stats.resolution
+        lines.append(
+            f"graphlint: {stats.total_seconds:.2f}s total; slowest: {slow}")
+        lines.append(
+            f"graphlint: resolution: {res['modules_indexed']} modules, "
+            f"{res['symbols_resolved']} symbols resolved / "
+            f"{res['symbols_unresolved']} stood down, "
+            f"{res['cross_module_traced']} cross-module traced defs")
     return "\n".join(lines)
 
 
@@ -43,7 +59,8 @@ def suppression_counts(files: Sequence[LintedFile]) -> Dict[str, int]:
 
 def json_report(findings: Sequence[Finding],
                 files: Sequence[LintedFile],
-                roots: Sequence[str]) -> str:
+                roots: Sequence[str],
+                stats: Optional[RunStats] = None) -> str:
     counts: Dict[str, int] = {}
     for fd in findings:
         counts[fd.rule] = counts.get(fd.rule, 0) + 1
@@ -58,4 +75,13 @@ def json_report(findings: Sequence[Finding],
         "suppressions_by_rule": suppression_counts(files),
         "clean": not findings,
     }
-    return json.dumps(payload, indent=2, sort_keys=True) + "\n"
+    if stats is not None:
+        payload["timing"] = {
+            "total_seconds": round(stats.total_seconds, 4),
+            "rule_wall_seconds": {
+                rule: round(sec, 4)
+                for rule, sec in sorted(stats.rule_seconds.items())},
+        }
+        payload["resolution"] = dict(stats.resolution)
+    return json.dumps(payload, indent=2, sort_keys=True,
+                      allow_nan=False) + "\n"

@@ -6,7 +6,10 @@ from typing import List
 from tools.graphlint.engine import Rule
 from tools.graphlint.rules.cli_drift import CliDriftRule
 from tools.graphlint.rules.collective_axes import CollectiveAxesRule
+from tools.graphlint.rules.compile_plan_contract import (
+    CompilePlanContractRule)
 from tools.graphlint.rules.donate import DonateRule
+from tools.graphlint.rules.donation_flow import DonationFlowRule
 from tools.graphlint.rules.host_sync import HostSyncRule
 from tools.graphlint.rules.json_nan import JsonNanRule
 from tools.graphlint.rules.pallas_interpret import PallasInterpretRule
@@ -21,4 +24,5 @@ def all_rules() -> List[Rule]:
     return [HostSyncRule(), RecompileRule(), PRNGReuseRule(),
             DonateRule(), RematTagRule(), CliDriftRule(),
             ShardingAxesRule(), CollectiveAxesRule(),
-            PallasInterpretRule(), JsonNanRule(), PallasRngRule()]
+            PallasInterpretRule(), JsonNanRule(), PallasRngRule(),
+            CompilePlanContractRule(), DonationFlowRule()]

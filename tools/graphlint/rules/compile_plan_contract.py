@@ -1,0 +1,328 @@
+"""GL112 — the compile-plan contract: jit wiring vs. declared plan data.
+
+Since PR 7, ``parallel/compile_plan.py`` is the ONE owner of mesh,
+NamedShardings, and donation for every jitted entry point, and
+``CompilePlan.describe()`` reports the module-level ``DONATE`` dict as
+declared data.  That makes the expected jit wiring *diffable*: any call
+site or builder that disagrees with the declaration is a bug waiting for
+a TPU run to find it.  GL107 already bans per-site sharding kwargs
+outside the plan; this rule closes the remainder (rule-wave-2(a)) with
+three distinct finding codes:
+
+- ``GL112-bypass`` / ``GL112-mismatch`` / ``GL112-donate-undeclared``
+  at call sites OUTSIDE the plan module: a ``jax.jit``/``jax.pmap`` that
+  stages a function resolving to a plan entry's name while carrying its
+  own ``in_shardings``/``out_shardings``/``donate_argnums`` — bypassing
+  the plan builder entirely, donating argnums that disagree with the
+  declared tuple, or donating an argument the plan never declares;
+- ``GL112-mismatch`` / ``GL112-donate-undeclared`` INSIDE the plan
+  module: a ``jit_<entry>`` builder whose ``jax.jit`` wires a donation
+  different from ``DONATE[<entry>]`` (including wiring another entry's
+  declaration), or donates for an entry the ``DONATE`` dict does not
+  declare at all;
+- ``GL112-unused-entry`` on the ``DONATE`` declaration: a plan entry no
+  ``jit_<entry>`` call site anywhere in the lint root uses.  When the
+  lint root contains NO plan-builder call sites at all (linting the plan
+  file alone), this check stands down — absence of callers is then a
+  property of the selection, not of the program.
+
+Plan discovery is structural, not path-hardcoded: a plan module is any
+linted file named ``compile_plan.py`` with a module-level ``DONATE``
+dict literal of string keys and int-tuple values.  A call site is
+matched to a plan through its imports (the project index resolves the
+imported module to the plan file); a file importing no plan falls back
+to the project's unique plan when exactly one exists, and stands down
+otherwise — the zero-false-positive contract.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from tools.graphlint.astutil import int_tuple_literal, qualname
+from tools.graphlint.engine import Context, Finding, LintedFile, Rule
+from tools.graphlint.project import ProjectIndex, get_index
+
+_JIT_CALLS = {"jax.jit", "jax.pmap"}
+_SITE_KWARGS = ("in_shardings", "out_shardings", "donate_argnums",
+                "donate_argnames")
+_PLAN_BASENAME = "compile_plan.py"
+
+
+@dataclasses.dataclass
+class PlanInfo:
+    """One discovered compile plan: its file plus the DONATE declaration."""
+
+    file: object                         # LintedFile of the plan module
+    donate: Dict[str, Tuple[int, ...]]   # entry -> declared argnums
+    donate_node: ast.Assign              # anchor for unused-entry findings
+
+
+def _parse_donate(node: ast.Assign) -> Optional[Dict[str, Tuple[int, ...]]]:
+    """``DONATE = {"entry": (0,), ...}`` -> {entry: argnums}; None when the
+    literal is not fully static (stand down on a dynamic plan)."""
+    if not isinstance(node.value, ast.Dict):
+        return None
+    out: Dict[str, Tuple[int, ...]] = {}
+    for k, v in zip(node.value.keys, node.value.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        nums = int_tuple_literal(v)
+        if nums is None:
+            # () / [] literals are empty donations, not parse failures
+            if isinstance(v, (ast.Tuple, ast.List)) and not v.elts:
+                nums = ()
+            else:
+                return None
+        out[k.value] = tuple(nums)
+    return out
+
+
+def plan_registry(ctx: Context) -> List[PlanInfo]:
+    """All compile plans in the lint root (cached per run; built from
+    ``ctx.files`` directly so rule selection cannot change the result)."""
+    cached = ctx.store.get("gl112_plans")
+    if cached is not None:
+        return cached
+    plans: List[PlanInfo] = []
+    for f in ctx.files:
+        if not f.rel.replace("\\", "/").endswith("/" + _PLAN_BASENAME) \
+                and f.rel.replace("\\", "/") != _PLAN_BASENAME:
+            continue
+        for stmt in f.tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "DONATE"):
+                donate = _parse_donate(stmt)
+                if donate is not None:
+                    plans.append(PlanInfo(file=f, donate=donate,
+                                          donate_node=stmt))
+                break
+    ctx.store["gl112_plans"] = plans
+    return plans
+
+
+def plans_imported_by(ctx: Context, f: LintedFile) -> List[PlanInfo]:
+    """The plans whose module ``f`` imports (by resolving each import
+    target's module part through the project index)."""
+    index = get_index(ctx)
+    plans = plan_registry(ctx)
+    if not plans or f is None:
+        return []
+    plan_files = {id(p.file): p for p in plans}
+    hits: Dict[int, PlanInfo] = {}
+    for target in index.import_targets.get(f, {}).values():
+        for dotted in (target, target.rsplit(".", 1)[0]):
+            mod_file = index._module_file(dotted)
+            if mod_file is not None and id(mod_file) in plan_files:
+                hits[id(mod_file)] = plan_files[id(mod_file)]
+    return list(hits.values())
+
+
+def plan_for_site(ctx: Context, f: LintedFile) -> Optional[PlanInfo]:
+    """The plan governing call sites in ``f``: the unique imported plan,
+    else the project's unique plan, else None (stand down)."""
+    imported = plans_imported_by(ctx, f)
+    if len(imported) == 1:
+        return imported[0]
+    if imported:
+        return None
+    plans = plan_registry(ctx)
+    return plans[0] if len(plans) == 1 else None
+
+
+def entry_donation(ctx: Context, f: LintedFile,
+                   entry: str) -> Optional[Tuple[int, ...]]:
+    """Declared argnums for ``entry`` as seen from file ``f``; None when
+    no governing plan declares it (stand down)."""
+    plan = plan_for_site(ctx, f)
+    if plan is None:
+        return None
+    return plan.donate.get(entry)
+
+
+def _donate_kwarg(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return kw.value
+    return None
+
+
+def _staged_fn_name(call: ast.Call, f: LintedFile,
+                    index: ProjectIndex) -> Optional[str]:
+    """Name of the function a jax.jit/jax.pmap call stages: the resolved
+    def's name when the project index can find it, else the bare local
+    name."""
+    arg = call.args[0] if call.args else None
+    if arg is None:
+        for kw in call.keywords:
+            if kw.arg == "fun":
+                arg = kw.value
+    if isinstance(arg, (ast.Name, ast.Attribute)):
+        hit = index.resolve_call_target(f, arg)
+        if hit is not None:
+            return hit[1].name
+    return arg.id if isinstance(arg, ast.Name) else None
+
+
+class CompilePlanContractRule(Rule):
+    id = "GL112"
+    name = "compile-plan-contract"
+    doc = ("jit wiring disagreeing with the compile plan's declared "
+           "DONATE data: per-site bypass/mismatch, undeclared donation, "
+           "unused plan entries")
+
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        plans = plan_registry(ctx)
+        if not plans:
+            return []
+        findings: List[Finding] = []
+        me = next((p for p in plans if p.file is f), None)
+        if me is not None:
+            self._check_plan_module(f, ctx, me, findings)
+        else:
+            self._check_call_sites(f, ctx, findings)
+        return findings
+
+    # ------------------------------------------------- inside the plan
+    def _check_plan_module(self, f: LintedFile, ctx: Context,
+                           plan: PlanInfo, findings: List[Finding]) -> None:
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                    and node.name.startswith("jit_")):
+                continue
+            entry = node.name[len("jit_"):]
+            for call in ast.walk(node):
+                if not (isinstance(call, ast.Call)
+                        and qualname(call.func, f.imports) in _JIT_CALLS):
+                    continue
+                self._check_builder_call(f, plan, entry, call, findings)
+
+        if self._any_builder_calls(ctx):
+            used = self._used_entries(ctx)
+            for entry in plan.donate:
+                if entry not in used:
+                    findings.append(self.finding(
+                        f, plan.donate_node,
+                        f"[GL112-unused-entry] plan entry {entry!r} is "
+                        f"declared in DONATE but no jit_{entry} call site "
+                        "exists in the lint root — dead wiring drifts; "
+                        "delete the entry or route a caller through it"))
+
+    def _check_builder_call(self, f: LintedFile, plan: PlanInfo,
+                            entry: str, call: ast.Call,
+                            findings: List[Finding]) -> None:
+        declared = plan.donate.get(entry)
+        kw = _donate_kwarg(call)
+        if kw is None:
+            wired: Optional[Tuple[int, ...]] = ()
+        elif (isinstance(kw, ast.Subscript)
+                and isinstance(kw.value, ast.Name)
+                and kw.value.id == "DONATE"
+                and isinstance(kw.slice, ast.Constant)
+                and isinstance(kw.slice.value, str)):
+            wired_entry = kw.slice.value
+            if wired_entry != entry:
+                findings.append(self.finding(
+                    f, call, f"[GL112-mismatch] builder jit_{entry} wires "
+                    f"DONATE[{wired_entry!r}] — another entry's donation; "
+                    f"wire DONATE[{entry!r}]"))
+                return
+            wired = plan.donate.get(wired_entry)
+        else:
+            wired = int_tuple_literal(kw)
+            if wired is None:
+                return                      # dynamic expression: stand down
+
+        if declared is None:
+            if wired:
+                findings.append(self.finding(
+                    f, call, f"[GL112-donate-undeclared] builder "
+                    f"jit_{entry} donates argnums {tuple(wired)} but the "
+                    f"DONATE dict declares no {entry!r} entry — "
+                    "describe() will under-report what this plan donates"))
+            return
+        if wired is not None and tuple(wired) != declared:
+            extra = sorted(set(wired) - set(declared))
+            if extra:
+                findings.append(self.finding(
+                    f, call, f"[GL112-donate-undeclared] builder "
+                    f"jit_{entry} donates argument(s) {extra} that "
+                    f"DONATE[{entry!r}] == {declared} does not declare"))
+            else:
+                findings.append(self.finding(
+                    f, call, f"[GL112-mismatch] builder jit_{entry} wires "
+                    f"donate_argnums {tuple(wired)} but DONATE[{entry!r}] "
+                    f"declares {declared}"))
+
+    # -------------------------------------------- outside the plan
+    def _check_call_sites(self, f: LintedFile, ctx: Context,
+                          findings: List[Finding]) -> None:
+        plan = plan_for_site(ctx, f)
+        if plan is None:
+            return
+        index = get_index(ctx)
+        for call in ast.walk(f.tree):
+            if not (isinstance(call, ast.Call)
+                    and qualname(call.func, f.imports) in _JIT_CALLS):
+                continue
+            if not any(kw.arg in _SITE_KWARGS for kw in call.keywords):
+                continue        # plain jax.jit(fn): GL107/plan not bypassed
+            name = _staged_fn_name(call, f, index)
+            if name is None or name not in plan.donate:
+                continue        # not a plan entry (or unresolvable)
+            declared = plan.donate[name]
+            kw = _donate_kwarg(call)
+            wired = () if kw is None else int_tuple_literal(kw)
+            if wired is None:
+                wired = ()      # dynamic donate expr: judge the bypass only
+            if tuple(wired) != declared:
+                extra = sorted(set(wired) - set(declared))
+                if extra:
+                    findings.append(self.finding(
+                        f, call, f"[GL112-donate-undeclared] jit of plan "
+                        f"entry {name!r} donates argument(s) {extra} that "
+                        f"the plan's DONATE[{name!r}] == {declared} does "
+                        "not declare"))
+                else:
+                    findings.append(self.finding(
+                        f, call, f"[GL112-mismatch] jit of plan entry "
+                        f"{name!r} wires donate_argnums {tuple(wired)} "
+                        f"but the plan declares {declared}"))
+            else:
+                findings.append(self.finding(
+                    f, call, f"[GL112-bypass] plan entry {name!r} is "
+                    "jitted here with inline "
+                    "in_shardings/out_shardings/donation instead of "
+                    f"through the plan's jit_{name} builder — per-site "
+                    "wiring drifts from describe()"))
+
+    # -------------------------------------------------------- usage scan
+    @staticmethod
+    def _builder_calls(ctx: Context) -> Dict[str, int]:
+        """Project-wide count of ``jit_<entry>``-shaped calls (bare name
+        or any-attribute), cached per run."""
+        cached = ctx.store.get("gl112_builder_calls")
+        if cached is not None:
+            return cached
+        counts: Dict[str, int] = {}
+        for f in ctx.files:
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else None)
+                if name and name.startswith("jit_"):
+                    counts[name] = counts.get(name, 0) + 1
+        ctx.store["gl112_builder_calls"] = counts
+        return counts
+
+    def _any_builder_calls(self, ctx: Context) -> bool:
+        return bool(self._builder_calls(ctx))
+
+    def _used_entries(self, ctx: Context) -> set:
+        return {name[len("jit_"):] for name in self._builder_calls(ctx)}

@@ -6,16 +6,21 @@ alive but reads garbage (or raises on backends that poison donated
 buffers).  tests/test_train_step.py's ``fresh()`` helper exists because the
 train step donates its state — this rule catches the pattern statically.
 
-Scope: module-local.  A name assigned ``jax.jit(fn, donate_argnums=...)``
-is a donating callable; at each call site the names passed in donated
-positions become dead; a later load of a dead name (before rebinding) is a
-finding.  Loop bodies are walked twice so the canonical bug — donating the
-same state every iteration without rebinding — is caught.
+Scope: module-local donors.  A name assigned ``jax.jit(fn,
+donate_argnums=...)`` is a donating callable; at each call site the names
+passed in donated positions become dead; a later load of a dead name
+(before rebinding) is a finding.  Loop bodies are walked twice so the
+canonical bug — donating the same state every iteration without
+rebinding — is caught.  Donors bound through the COMPILE PLAN's builders
+(``plan.jit_train_step(...)``), including ones imported from another
+module, are GL113's job (rules/donation_flow.py) — it reuses this
+module's :class:`DonationWalker` so both rules agree on what "reuse"
+means.
 """
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from tools.graphlint.astutil import (FuncNode, int_tuple_literal, qualname,
                                      str_tuple_literal)
@@ -24,101 +29,79 @@ from tools.graphlint.engine import Context, Finding, LintedFile, Rule
 _JIT_CALLS = {"jax.jit", "jax.pmap"}
 
 
-class _DonSpec:
-    def __init__(self, nums: Tuple[int, ...], names: Tuple[str, ...]):
+class DonSpec:
+    """Which arguments of a donating callable are donated."""
+
+    def __init__(self, nums: Tuple[int, ...], names: Tuple[str, ...] = ()):
         self.nums = nums
         self.names = names
 
 
-class DonateRule(Rule):
-    id = "GL104"
-    name = "use-after-donate"
-    doc = "reading a buffer after passing it in a donate_argnums position"
+class DonationWalker:
+    """Flow walk shared by GL104 (module-local donors) and GL113
+    (plan-builder donors): tracks names whose buffers died at a donating
+    call and reports loads of a dead name before rebinding.
 
-    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
-        donors = self._donating_callables(f)
-        if not donors:
-            return []
-        findings: List[Finding] = []
+    ``on_use(node, name, donated_line)`` is called once per (name, line)
+    of dead-name reuse; the owning rule turns it into a finding.
+    """
+
+    def __init__(self, donors: Dict[str, DonSpec],
+                 on_use: Callable[[ast.AST, str, int], None]) -> None:
+        self.donors = donors
+        self.on_use = on_use
+        self._emitted: Set[Tuple[str, int]] = set()
+
+    def walk_module(self, f: LintedFile) -> None:
         for func in ast.walk(f.tree):
             if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self._walk_block(f, func.body, donors, {}, findings, set())
-        # module top level too
-        self._walk_block(f, f.tree.body, donors, {}, findings, set())
-        return findings
-
-    def _donating_callables(self, f: LintedFile) -> Dict[str, _DonSpec]:
-        donors: Dict[str, _DonSpec] = {}
-        for node in ast.walk(f.tree):
-            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and isinstance(node.value, ast.Call)
-                    and qualname(node.value.func, f.imports) in _JIT_CALLS):
-                continue
-            nums: Tuple[int, ...] = ()
-            names: Tuple[str, ...] = ()
-            for kw in node.value.keywords:
-                if kw.arg == "donate_argnums":
-                    nums = int_tuple_literal(kw.value) or ()
-                elif kw.arg == "donate_argnames":
-                    names = str_tuple_literal(kw.value) or ()
-            if nums or names:
-                donors[node.targets[0].id] = _DonSpec(nums, names)
-        return donors
+                self._walk_block(func.body, {})
+        self._walk_block(f.tree.body, {})
 
     # dead: name -> line where it was donated
-    def _walk_block(self, f, stmts, donors, dead: Dict[str, int],
-                    findings, emitted: Set[Tuple[str, int]]) -> None:
+    def _walk_block(self, stmts, dead: Dict[str, int]) -> None:
         for stmt in stmts:
-            self._walk_stmt(f, stmt, donors, dead, findings, emitted)
+            self._walk_stmt(stmt, dead)
 
-    def _walk_stmt(self, f, stmt, donors, dead, findings, emitted) -> None:
+    def _walk_stmt(self, stmt, dead: Dict[str, int]) -> None:
         if isinstance(stmt, FuncNode):
             return
         if isinstance(stmt, ast.If):
-            self._scan_expr(f, stmt.test, donors, dead, findings, emitted)
+            self._scan_expr(stmt.test, dead)
             d1, d2 = dict(dead), dict(dead)
-            self._walk_block(f, stmt.body, donors, d1, findings, emitted)
-            self._walk_block(f, stmt.orelse, donors, d2, findings, emitted)
+            self._walk_block(stmt.body, d1)
+            self._walk_block(stmt.orelse, d2)
             dead.clear()
             dead.update({**d2, **d1})      # dead in either branch -> dead
             return
         if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
             if isinstance(stmt, (ast.For, ast.AsyncFor)):
-                self._scan_expr(f, stmt.iter, donors, dead, findings,
-                                emitted)
+                self._scan_expr(stmt.iter, dead)
                 self._rebind_target(stmt.target, dead)
             else:
-                self._scan_expr(f, stmt.test, donors, dead, findings,
-                                emitted)
+                self._scan_expr(stmt.test, dead)
             for _ in range(2):     # second pass: donated last iteration
-                self._walk_block(f, stmt.body, donors, dead, findings,
-                                 emitted)
-            self._walk_block(f, stmt.orelse, donors, dead, findings,
-                             emitted)
+                self._walk_block(stmt.body, dead)
+            self._walk_block(stmt.orelse, dead)
             return
         if isinstance(stmt, ast.Assign):
-            self._scan_expr(f, stmt.value, donors, dead, findings, emitted)
+            self._scan_expr(stmt.value, dead)
             for t in stmt.targets:
                 self._rebind_target(t, dead)
             return
         if isinstance(stmt, ast.Try):
-            self._walk_block(f, stmt.body, donors, dead, findings, emitted)
+            self._walk_block(stmt.body, dead)
             for h in stmt.handlers:
-                self._walk_block(f, h.body, donors, dict(dead), findings,
-                                 emitted)
-            self._walk_block(f, stmt.orelse, donors, dead, findings,
-                             emitted)
-            self._walk_block(f, stmt.finalbody, donors, dead, findings,
-                             emitted)
+                self._walk_block(h.body, dict(dead))
+            self._walk_block(stmt.orelse, dead)
+            self._walk_block(stmt.finalbody, dead)
             return
         if isinstance(stmt, ast.With):
             for item in stmt.items:
-                self._scan_expr(f, item.context_expr, donors, dead,
-                                findings, emitted)
-            self._walk_block(f, stmt.body, donors, dead, findings, emitted)
+                self._scan_expr(item.context_expr, dead)
+            self._walk_block(stmt.body, dead)
             return
-        self._scan_expr(f, stmt, donors, dead, findings, emitted)
+        self._scan_expr(stmt, dead)
 
     def _rebind_target(self, target, dead: Dict[str, int]) -> None:
         if isinstance(target, ast.Name):
@@ -127,7 +110,7 @@ class DonateRule(Rule):
             for e in target.elts:
                 self._rebind_target(e, dead)
 
-    def _scan_expr(self, f, node, donors, dead, findings, emitted) -> None:
+    def _scan_expr(self, node, dead: Dict[str, int]) -> None:
         if node is None:
             return
         # source-order walk: loads checked before this statement's donations
@@ -138,8 +121,8 @@ class DonateRule(Rule):
         newly_donated: List[Tuple[str, int]] = []
         for n in nodes:
             if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
-                    and n.func.id in donors):
-                spec = donors[n.func.id]
+                    and n.func.id in self.donors):
+                spec = self.donors[n.func.id]
                 for i, arg in enumerate(n.args):
                     if i in spec.nums and isinstance(arg, ast.Name):
                         newly_donated.append((arg.id, n.lineno))
@@ -154,12 +137,48 @@ class DonateRule(Rule):
             if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
                     and n.id in dead):
                 mark = (n.id, getattr(n, "lineno", 0))
-                if mark not in emitted:
-                    emitted.add(mark)
-                    findings.append(self.finding(
-                        f, n, f"{n.id!r} was donated to a jitted call "
-                        f"(donate_argnums) at line {dead[n.id]}; its "
-                        "buffer is dead — copy it first or rebind the "
-                        "result over the input"))
+                if mark not in self._emitted:
+                    self._emitted.add(mark)
+                    self.on_use(n, n.id, dead[n.id])
         for name, line in newly_donated:
             dead[name] = line
+
+
+class DonateRule(Rule):
+    id = "GL104"
+    name = "use-after-donate"
+    doc = "reading a buffer after passing it in a donate_argnums position"
+
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        donors = self._donating_callables(f)
+        if not donors:
+            return []
+        findings: List[Finding] = []
+
+        def on_use(node: ast.AST, name: str, line: int) -> None:
+            findings.append(self.finding(
+                f, node, f"{name!r} was donated to a jitted call "
+                f"(donate_argnums) at line {line}; its buffer is dead — "
+                "copy it first or rebind the result over the input"))
+
+        DonationWalker(donors, on_use).walk_module(f)
+        return findings
+
+    def _donating_callables(self, f: LintedFile) -> Dict[str, DonSpec]:
+        donors: Dict[str, DonSpec] = {}
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                    and qualname(node.value.func, f.imports) in _JIT_CALLS):
+                continue
+            nums: Tuple[int, ...] = ()
+            names: Tuple[str, ...] = ()
+            for kw in node.value.keywords:
+                if kw.arg == "donate_argnums":
+                    nums = int_tuple_literal(kw.value) or ()
+                elif kw.arg == "donate_argnames":
+                    names = str_tuple_literal(kw.value) or ()
+            if nums or names:
+                donors[node.targets[0].id] = DonSpec(nums, names)
+        return donors

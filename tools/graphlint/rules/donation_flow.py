@@ -1,0 +1,133 @@
+"""GL113 — cross-module use-after-donate through the compile plan.
+
+GL104 catches ``name = jax.jit(fn, donate_argnums=...)`` donors declared
+in the same module, but since PR 7 nothing in the tree spells donation
+that way: the donation lives in ``compile_plan.DONATE`` and call sites
+bind ``train_step = plan.jit_train_step(...)`` — a call whose donation is
+invisible module-locally.  This rule closes that gap: a caller that binds
+a plan builder's result (locally, or importing a module-level binding
+from another file) and then reuses a pytree it passed in a DONATED
+position of that entry point is flagged, with the plan declaration named
+in the finding.
+
+Donor discovery (stand down on anything else, per the house rule):
+
+- ``name = <anything>.jit_<entry>(...)`` or ``name = jit_<entry>(...)``
+  where the governing plan (the file's imported ``compile_plan`` module,
+  or the project's unique plan) declares a NON-EMPTY
+  ``DONATE[<entry>]``;
+- an imported name resolving (one hop, through the project index) to
+  such a module-level binding in its defining file — the
+  "wiring module binds it, driver module loops over it" split;
+- attribute bindings (``self._jitted = ...``) and tuple-unpack plumbing
+  (``train_step, eval_step, ... = setup_training(...)``) do not resolve
+  statically and stand down.
+
+Reuse semantics are exactly GL104's :class:`~.donate.DonationWalker`
+(same dead-name tracking, branch merge, double-pass loops), so the two
+rules can never disagree about what counts as a read-after-donate.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.graphlint.engine import Context, Finding, LintedFile, Rule
+from tools.graphlint.project import get_index
+from tools.graphlint.rules.compile_plan_contract import (entry_donation,
+                                                         plan_registry)
+from tools.graphlint.rules.donate import DonationWalker, DonSpec
+
+
+def _builder_entry(call: ast.AST) -> Optional[str]:
+    """``<recv>.jit_<entry>(...)`` / ``jit_<entry>(...)`` -> entry name."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    name = (fn.id if isinstance(fn, ast.Name)
+            else fn.attr if isinstance(fn, ast.Attribute) else None)
+    if name and name.startswith("jit_") and len(name) > len("jit_"):
+        return name[len("jit_"):]
+    return None
+
+
+class _Donor(DonSpec):
+    def __init__(self, nums: Tuple[int, ...], entry: str, origin: str):
+        super().__init__(nums)
+        self.entry = entry
+        self.origin = origin      # "" for local, " (bound at ...)" imported
+
+
+class DonationFlowRule(Rule):
+    id = "GL113"
+    name = "donation-flow"
+    doc = ("reusing a pytree passed in a donated position of a compile-"
+           "plan entry point (cross-module: imported donor bindings "
+           "resolve through the project index)")
+
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        if not plan_registry(ctx):
+            return []
+        donors = self._donors(f, ctx)
+        if not donors:
+            return []
+        findings: List[Finding] = []
+
+        def on_use(node: ast.AST, name: str, line: int) -> None:
+            # the walker only kills names via donors, so the donating
+            # callee at `line` is recoverable from any donor — find the
+            # one whose call site produced the kill for the message
+            findings.append(self.finding(
+                f, node, f"{name!r} was passed in a donated position at "
+                f"line {line} of a compile-plan entry point; its buffer "
+                "is dead — copy it first or rebind the result over the "
+                "input" + self._context_for(donors, f, line)))
+
+        DonationWalker(donors, on_use).walk_module(f)
+        return findings
+
+    @staticmethod
+    def _context_for(donors: Dict[str, DonSpec], f: LintedFile,
+                     line: int) -> str:
+        """Name the plan entry whose call at ``line`` killed the buffer."""
+        for node in ast.walk(f.tree):
+            if (isinstance(node, ast.Call)
+                    and getattr(node, "lineno", -1) == line
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in donors):
+                d = donors[node.func.id]
+                if isinstance(d, _Donor):
+                    return (f" [plan entry {d.entry!r} declares "
+                            f"DONATE == {tuple(d.nums)}{d.origin}]")
+        return ""
+
+    def _donors(self, f: LintedFile, ctx: Context) -> Dict[str, DonSpec]:
+        donors: Dict[str, DonSpec] = {}
+        # local bindings: name = plan.jit_<entry>(...)
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            entry = _builder_entry(node.value)
+            if entry is None:
+                continue
+            nums = entry_donation(ctx, f, entry)
+            if nums:
+                donors[node.targets[0].id] = _Donor(nums, entry, "")
+        # imported bindings: from wiring import train_step
+        index = get_index(ctx)
+        imported = set(index.import_targets.get(f, {})) - set(donors)
+        for name in sorted(imported):
+            hit = index.resolve_toplevel_assign(f, name)
+            if hit is None:
+                continue
+            mod_file, assign = hit
+            entry = _builder_entry(assign.value)
+            if entry is None:
+                continue
+            nums = entry_donation(ctx, mod_file, entry)
+            if nums:
+                donors[name] = _Donor(
+                    nums, entry,
+                    f"; donor bound at {mod_file.rel}:{assign.lineno}")
+        return donors

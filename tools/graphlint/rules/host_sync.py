@@ -23,16 +23,21 @@ Flagged inside traced scopes:
   time and are constant-folded into the executable — the "timing" they
   produce is a frozen compile-time value that measures nothing per step.
   Time at the DISPATCH site instead (observability/spans.py module doc).
+
+Wave 3: traced scope is WHOLE-PROGRAM (tools/graphlint/project.py) — a
+function jitted in module A but defined in module B fires here at B's
+definition site, with A's jit site named in the finding.  Unresolvable
+imports stand down, per the house rule.
 """
 from __future__ import annotations
 
 import ast
 from typing import List
 
-from tools.graphlint.astutil import (ARRAY, STATIC, ExprClassifier, FuncNode,
-                                     direct_body_walk, qualname,
-                                     traced_functions)
+from tools.graphlint.astutil import (ARRAY, STATIC, ExprClassifier,
+                                     direct_body_walk, qualname)
 from tools.graphlint.engine import Context, Finding, LintedFile, Rule
+from tools.graphlint.project import project_traced
 
 _SYNC_METHODS = {"item", "tolist", "block_until_ready",
                  "copy_to_host_async", "__array__"}
@@ -57,12 +62,20 @@ class HostSyncRule(Rule):
     id = "GL101"
     name = "host-sync-in-traced-code"
     doc = ("host transfer / numpy materialization inside jit/scan-reachable "
-           "code")
+           "code (whole-program: cross-module jit sites propagate)")
+
+    _suffix = ""
+
+    def finding(self, f: LintedFile, node, message: str) -> Finding:
+        return super().finding(f, node, message + self._suffix)
 
     def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
         findings: List[Finding] = []
-        traced = traced_functions(f.tree, f.imports)
-        for func in traced:
+        traced = project_traced(ctx).get(f, {})
+        for func, site in traced.items():
+            # cross-module scope: name the jit site that staged this def
+            self._suffix = ("" if site is None
+                            else f" [traced via {site.describe()}]")
             cls = ExprClassifier.for_function(func, f.imports)
             for node in _linear(func):
                 if isinstance(node, ast.Assign):

@@ -13,26 +13,31 @@ operand, so the kernel body is a deterministic function of its inputs.
 ``prng_random_bits`` — which this rule deliberately does not flag; it is
 the supported spelling when in-kernel randomness is genuinely needed.)
 
-Detection is module-local and resolution-based (the GL109
-zero-false-positive contract):
+Detection is resolution-based (the GL109 zero-false-positive contract)
+and, since wave 3, WHOLE-PROGRAM:
 
-- a **kernel body** is any module-local ``def`` passed (bare, through
+- a **kernel body** is any ``def`` passed (bare, through
   ``functools.partial``, or through a simple ``name =
   functools.partial(fn, ...)`` binding — the ops/fused_augment.py
   spelling) as the kernel argument of a call resolving to
-  ``pallas_call``, closed over bare-name calls to other module-local defs
-  (a kernel delegating its math to a helper keeps the helper in scope);
+  ``pallas_call`` — including a def IMPORTED from another module, which
+  is resolved through the project index (tools/graphlint/project.py)
+  and flagged at its definition site with the pallas_call site named;
+- kernel scopes close over the helpers a kernel body calls — bare-name
+  module-local defs, and imported defs through the index;
 - inside those scopes, any call resolving to ``jax.random.*`` is flagged;
-- kernels referenced any other way (attribute lookups, ``**kwargs``)
-  cannot be resolved statically and stand down.
+- kernels referenced any other way (attribute expressions that do not
+  resolve, ``**kwargs``) cannot be resolved statically and stand down.
 """
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from tools.graphlint.astutil import FuncNode, qualname
 from tools.graphlint.engine import Context, Finding, LintedFile, Rule
+from tools.graphlint.project import (MAX_CROSS_MODULE_DEPTH, TraceSite,
+                                     get_index)
 
 _RANDOM_PREFIX = "jax.random."
 
@@ -78,50 +83,98 @@ def _kernel_arg(node: ast.Call, f: LintedFile) -> ast.AST | None:
     return _unwrap_partial(cand, f)
 
 
-class PallasRngRule(Rule):
-    id = "GL111"
-    name = "pallas-kernel-host-rng"
-    doc = ("jax.random.* inside a Pallas kernel body has no Mosaic "
-           "lowering — draw randomness outside the pallas_call and pass "
-           "it as an operand (ops/fused_augment.py is the pattern)")
+def _kernel_scopes(ctx: Context
+                   ) -> Dict[object, Dict[ast.AST, Optional[TraceSite]]]:
+    """Project-wide kernel scopes: file -> {kernel def/lambda -> None
+    (staged in the same module) | TraceSite (the cross-module
+    pallas_call that staged it)}.  Built once per lint run."""
+    cached = ctx.store.get("pallas_kernel_scopes")
+    if cached is not None:
+        return cached
+    index = get_index(ctx)
+    scopes: Dict[object, Dict[ast.AST, Optional[TraceSite]]] = {
+        f: {} for f in ctx.files}
 
-    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
-        findings: List[Finding] = []
-        by_name: Dict[str, List[ast.AST]] = {}
+    by_name: Dict[object, Dict[str, List[ast.AST]]] = {}
+    for f in ctx.files:
+        names: Dict[str, List[ast.AST]] = {}
         for node in ast.walk(f.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                by_name.setdefault(node.name, []).append(node)
+                names.setdefault(node.name, []).append(node)
+        by_name[f] = names
 
-        # kernel bodies: defs/lambdas handed to a pallas_call
+    work: List[Tuple[object, ast.AST, Optional[TraceSite], int]] = []
+    for f in ctx.files:
         partials = _partial_bindings(f)
-        kernels: Set[ast.AST] = set()
         for node in ast.walk(f.tree):
             if not isinstance(node, ast.Call) or not _is_pallas_call(node,
                                                                      f):
                 continue
             arg = _kernel_arg(node, f)
             if isinstance(arg, ast.Lambda):
-                kernels.add(arg)
+                work.append((f, arg, None, 0))
             elif isinstance(arg, ast.Name):
                 name = partials.get(arg.id, arg.id)
-                kernels.update(by_name.get(name, ()))
-            # attribute refs / **kwargs: unresolvable, stand down
+                local = by_name[f].get(name, ())
+                if local:
+                    for k in local:
+                        work.append((f, k, None, 0))
+                else:
+                    # imported kernel: resolve to its defining module and
+                    # flag there, naming this staging site
+                    target = index.import_targets[f].get(name)
+                    hit = index.resolve_symbol(target) if target else None
+                    if hit is not None:
+                        site = TraceSite(f.rel, node.lineno, "pallas_call")
+                        work.append((hit[0], hit[1], site, 1))
+            # other attribute refs / **kwargs: unresolvable, stand down
 
-        # close over module-local helpers a kernel body calls by bare name
-        changed = True
-        while changed:
-            changed = False
-            for fn in list(kernels):
-                for node in ast.walk(fn):
-                    if (isinstance(node, ast.Call)
-                            and isinstance(node.func, ast.Name)):
-                        for callee in by_name.get(node.func.id, ()):
-                            if callee not in kernels:
-                                kernels.add(callee)
-                                changed = True
+    visited: Set[Tuple[int, int]] = set()
+    while work:
+        kf, kdef, site, depth = work.pop()
+        mark = (id(kf), id(kdef))
+        if mark in visited:
+            continue
+        visited.add(mark)
+        cur = scopes[kf].get(kdef, "absent")
+        if cur is None:
+            continue                     # local staging already recorded
+        scopes[kf][kdef] = site
+        # helpers the kernel body calls stay in kernel scope
+        for node in ast.walk(kdef):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name):
+                local = by_name[kf].get(node.func.id, ())
+                for callee in local:
+                    work.append((kf, callee, site, depth))
+                if not local and depth < MAX_CROSS_MODULE_DEPTH:
+                    target = index.import_targets[kf].get(node.func.id)
+                    hit = index.resolve_symbol(target) if target else None
+                    if hit is not None:
+                        hsite = site or TraceSite(kf.rel, node.lineno,
+                                                  "pallas kernel helper")
+                        work.append((hit[0], hit[1], hsite, depth + 1))
 
+    ctx.store["pallas_kernel_scopes"] = scopes
+    return scopes
+
+
+class PallasRngRule(Rule):
+    id = "GL111"
+    name = "pallas-kernel-host-rng"
+    doc = ("jax.random.* inside a Pallas kernel body has no Mosaic "
+           "lowering — draw randomness outside the pallas_call and pass "
+           "it as an operand (ops/fused_augment.py is the pattern); "
+           "whole-program: imported kernels resolve to their definition")
+
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        kernels = _kernel_scopes(ctx).get(f, {})
         seen: Set[ast.AST] = set()
-        for fn in kernels:
+        for fn, site in kernels.items():
+            suffix = ("" if site is None
+                      else f" [kernel staged via {site.describe()}]")
             for node in ast.walk(fn):
                 if (isinstance(node, FuncNode) and node is not fn
                         and node in kernels):
@@ -139,5 +192,5 @@ class PallasRngRule(Rule):
                         "tier-1 passes while the TPU build breaks); draw "
                         "the randomness outside the pallas_call and pass "
                         "it as an operand, or use the pltpu in-kernel "
-                        "PRNG"))
+                        "PRNG" + suffix))
         return findings

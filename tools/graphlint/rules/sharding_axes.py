@@ -36,7 +36,9 @@ from tools.graphlint.engine import Context, Finding, LintedFile, Rule
 # jit-family callables whose sharding kwargs must live in the plan module.
 _JIT_QUALS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
 _SHARDING_KWARGS = ("in_shardings", "out_shardings")
-# the one module allowed to pass them (path compared with / separators)
+# the canonical plan module named in messages; the EXEMPTION is structural
+# (any compile_plan.py with a static DONATE — GL112's plan_registry), so a
+# plan module is never told to move its shardings into itself
 _PLAN_SUFFIX = "parallel/compile_plan.py"
 
 _PSPEC_TAIL = "PartitionSpec"
@@ -95,10 +97,14 @@ class ShardingAxesRule(Rule):
 
     # ------------------------------------------------------------- phase 2
     def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        # late import: sibling rule module, avoids import-time cycles
+        from tools.graphlint.rules.compile_plan_contract import plan_registry
         st = _store(ctx)
         findings: List[Finding] = []
         consts = module_str_constants(f.tree)
         rel = f.rel.replace("\\", "/")
+        is_plan_module = (rel.endswith(_PLAN_SUFFIX)
+                          or any(p.file is f for p in plan_registry(ctx)))
 
         for node in ast.walk(f.tree):
             if not isinstance(node, ast.Call):
@@ -110,7 +116,7 @@ class ShardingAxesRule(Rule):
             if (not jit_like and q == "functools.partial" and node.args):
                 jit_like = qualname(node.args[0],
                                     f.imports) in _JIT_QUALS
-            if jit_like and not rel.endswith(_PLAN_SUFFIX):
+            if jit_like and not is_plan_module:
                 for kw in node.keywords:
                     if kw.arg in _SHARDING_KWARGS:
                         findings.append(self.finding(
