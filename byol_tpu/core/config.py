@@ -239,6 +239,20 @@ class DeviceConfig:
                                         # state HBM per chip); 'off' lowers
                                         # the replicated graph unchanged.
                                         # parallel/{compile_plan,zero1}.py
+    flat_resident: str = "off"          # resident flat update state
+                                        # (parallel/flat_state.py): 'on'
+                                        # keeps LARS momentum, the EMA
+                                        # target, and (under zero1) the
+                                        # param shadow as ONE flat fp32
+                                        # buffer each across steps — packed
+                                        # once at setup, zero per-step
+                                        # pack/unpack, gathers bucketed.
+                                        # Requires --fused-update on;
+                                        # 'off' lowers the transient graph
+                                        # unchanged.
+    flat_bucket_mb: int = 64            # bucket budget (MiB of gathered
+                                        # bytes) for the resident layout's
+                                        # coalesced all-gathers
 
 
 @_frozen
@@ -405,6 +419,24 @@ def resolve(cfg: Config, *, num_train_samples: int, num_test_samples: int,
                 "--model-parallel > 1 (tensor parallelism shards head "
                 "opt-state leaves over 'model'; the fused kernel's flat "
                 "buffer would un-shard them every step)")
+    if cfg.device.flat_resident not in ("off", "on"):
+        raise ValueError(
+            f"unknown flat_resident mode {cfg.device.flat_resident!r}; "
+            "'off' | 'on'")
+    if cfg.device.flat_resident == "on":
+        if cfg.optim.fused_update != "on":
+            raise ValueError(
+                "--flat-resident on requires --fused-update on: the "
+                "resident buffers are laid out for (and consumed by) the "
+                "fused kernel — the optax chain has no flat entry point")
+        if cfg.device.model_parallel > 1:
+            raise ValueError(
+                "--flat-resident on lays the update state out over the "
+                "data axis; it does not compose with --model-parallel > 1")
+        if cfg.device.flat_bucket_mb < 1:
+            raise ValueError(
+                "--flat-bucket-mb must be >= 1, got "
+                f"{cfg.device.flat_bucket_mb}")
     if cfg.task.fused_augment not in ("off", "on"):
         raise ValueError(
             f"unknown fused_augment mode {cfg.task.fused_augment!r}; "

@@ -53,15 +53,23 @@ under the Pallas interpreter so CPU tier-1 exercises the real kernel code
 path — the flash_attention.py pattern, enforced tree-wide by graphlint
 GL109.
 
-Known cost not yet measured on silicon: :func:`pack_flat` /
-:func:`unpack_flat` run per step, and a concatenate feeding an opaque
-custom call (plus slices of its outputs) materializes as real copies XLA
-cannot elide — traffic the unfused chain does not pay.  Whether the fused
-sweep still nets out ahead (the chain's per-leaf norm reductions break
-elementwise fusion, so it is not free either) is exactly what the pending
-``bench.py --fused-ab`` TPU row decides; the structural fix if it does
-not — storing the update state as ONE resident flat buffer across steps
-so pack/unpack disappears entirely — is filed in ROADMAP.md.
+Pack/unpack cost: with ``--flat-resident off`` (the transient layout),
+:func:`pack_flat` / :func:`unpack_flat` run per step — a concatenate
+feeding an opaque custom call (plus slices of its outputs) materializes
+as real copies XLA cannot elide, traffic the unfused chain does not pay
+(RESULTS.md carries the matching caveat on the CPU-interpreter rows).
+``--flat-resident on`` (parallel/flat_state.py) removes that cost
+structurally: the momentum, the EMA target, and (under ZeRO-1) the param
+shadow LIVE as resident flat buffers across steps, packed once at setup,
+so :func:`fused_lars_ema_update_resident` /
+:func:`fused_lars_ema_update_resident_zero1` pack only the fresh
+GRADIENTS per step (one concatenate, unavoidable: autodiff emits shaped
+leaves) and unpack nothing — state outputs stay buffers, aliased onto
+their inputs step over step by the jit donation.  The off/on A/B on
+silicon is ``bench.py --resident-ab`` (the TPU capture row ROADMAP.md
+tracks); both layouts share :func:`_fused_update_buffers`, so the
+resident path can never drift numerically from the transient one
+(parity pinned by tests/test_flat_state.py).
 """
 from __future__ import annotations
 
@@ -244,21 +252,47 @@ def _fused_update_lists(p_list, g_list, m_list, t_list, lr, tau, *,
                         eps: float, ema_pre: bool,
                         axis_name: Optional[str],
                         block_rows: Optional[int], interpret: bool):
-    """Core fused update on lists of (local) leaves.
-
-    Runs the two kernel passes over the packed buffer; ``axis_name`` set
-    means the lists are shard-local (inside shard_map) and the segment
-    norms need a psum to be global.  Returns (p', m', t', trust_vector)
-    with trust_vector = the applied ratios of the ADAPTED segments in
-    tree order (the optim/lars.py ``trust_ratio_vector`` contract).
+    """Fused update on lists of (local) leaves: the TRANSIENT layout —
+    pack all four trees, run :func:`_fused_update_buffers`, return the
+    buffers for the caller to unpack.  ``axis_name`` set means the lists
+    are shard-local (inside shard_map) and the segment norms need a psum
+    to be global.  Returns (p', m', t', trust_vector) with trust_vector =
+    the applied ratios of the ADAPTED segments in tree order (the
+    optim/lars.py ``trust_ratio_vector`` contract).
     """
     br = resolve_block_rows(seg.num_rows, interpret, block_rows)
-    nblocks = -(-seg.num_rows // br)
-    grid_rows = nblocks * br
-    p_buf = pack_flat(p_list, seg, grid_rows)
-    g_buf = pack_flat(g_list, seg, grid_rows)
-    m_buf = pack_flat(m_list, seg, grid_rows)
-    t_buf = pack_flat(t_list, seg, grid_rows)
+    grid_rows = -(-seg.num_rows // br) * br
+    return _fused_update_buffers(
+        pack_flat(p_list, seg, grid_rows),
+        pack_flat(g_list, seg, grid_rows),
+        pack_flat(m_list, seg, grid_rows),
+        pack_flat(t_list, seg, grid_rows),
+        lr, tau, seg=seg, weight_decay=weight_decay,
+        momentum_decay=momentum_decay,
+        trust_coefficient=trust_coefficient, eps=eps, ema_pre=ema_pre,
+        axis_name=axis_name, block_rows=br, interpret=interpret)
+
+
+def _fused_update_buffers(p_buf, g_buf, m_buf, t_buf, lr, tau, *,
+                          seg: SegmentMap, weight_decay: float,
+                          momentum_decay: float, trust_coefficient: float,
+                          eps: float, ema_pre: bool,
+                          axis_name: Optional[str], block_rows: int,
+                          interpret: bool):
+    """The kernel core on PACKED ``(grid_rows, 128)`` fp32 buffers.
+
+    Shared verbatim by the transient path (packed per step above) and the
+    resident path (buffers live across steps, parallel/flat_state.py) —
+    one implementation, so the two layouts cannot drift numerically.
+    ``block_rows`` here is the RESOLVED tile height and must divide the
+    buffers' row count (the resident layout bakes it in at build time).
+    """
+    br = block_rows
+    grid_rows = p_buf.shape[0]
+    if grid_rows % br:
+        raise ValueError(
+            f"buffer rows {grid_rows} not a multiple of block_rows {br}")
+    nblocks = grid_rows // br
 
     # per-row statics: segment id (grid-tail rows fold into the last
     # segment — their data is zeros, inert everywhere) and weight decay
@@ -436,3 +470,105 @@ def fused_lars_ema_update_zero1(flat_params: Any, flat_grads: Any,
     unflatten = jax.tree_util.tree_unflatten
     return (unflatten(treedef, new_p), unflatten(treedef, new_m),
             unflatten(treedef, new_t), trust)
+
+
+def fused_lars_ema_update_resident(params: Any, grads: Any,
+                                   m_buf: jnp.ndarray, t_buf: jnp.ndarray,
+                                   *, layout: Any, lr, tau,
+                                   weight_decay: float,
+                                   momentum_decay: float,
+                                   trust_coefficient: float = lars_lib.TRUST_COEFFICIENT_DEFAULT,
+                                   eps: float = lars_lib.LARS_EPS_DEFAULT,
+                                   ema_pre: bool = False, mesh=None,
+                                   interpret: Optional[bool] = None):
+    """Fused update with RESIDENT momentum/target buffers, replicated
+    layout (``--flat-resident on --zero1 off``).
+
+    ``params``/``grads`` are shaped trees — params stay shaped for the
+    forward, and gradients are fresh autodiff outputs, so both are packed
+    here per step — while ``m_buf``/``t_buf`` are the resident
+    ``(layout.global_size,)`` fp32 buffers (parallel/flat_state.py,
+    ``num_shards == 1``) consumed and produced IN PLACE: same shape, same
+    sharding, so the jit-level state donation aliases them step over
+    step and the momentum/target pack+unpack copies of the transient
+    path never happen.  Returns ``(new_params, new_p_buf, new_m_buf,
+    new_t_buf, trust_vector)`` — ``new_p_buf`` is the kernel's own packed
+    view of the fresh params (no extra compute: it IS the kernel output
+    the shaped params are carved from), handed back so telemetry can norm
+    the buffer directly.
+    """
+    interpret = _resolve_interpret(interpret)
+    seg, gr, br = layout.seg, layout.grid_rows, layout.block_rows
+    p_leaves = layout.treedef.flatten_up_to(params)
+    g_leaves = layout.treedef.flatten_up_to(grads)
+
+    def run(p_l, g_l, m_b, t_b, lr_, tau_):
+        p_out, m_out, t_out, trust = _fused_update_buffers(
+            pack_flat(p_l, seg, gr), pack_flat(g_l, seg, gr),
+            m_b.reshape(gr, _LANES), t_b.reshape(gr, _LANES), lr_, tau_,
+            seg=seg, weight_decay=weight_decay,
+            momentum_decay=momentum_decay,
+            trust_coefficient=trust_coefficient, eps=eps, ema_pre=ema_pre,
+            axis_name=None, block_rows=br, interpret=interpret)
+        return (unpack_flat(p_out, seg, p_l), p_out.reshape(-1),
+                m_out.reshape(-1), t_out.reshape(-1), trust)
+
+    if mesh is not None and math.prod(mesh.shape.values()) > 1:
+        rep = P()
+        run = _shard_map(run, mesh,
+                         in_specs=(rep, rep, rep, rep, rep, rep),
+                         out_specs=(rep, rep, rep, rep, rep))
+    new_p, p_out, m_out, t_out, trust = run(p_leaves, g_leaves, m_buf,
+                                            t_buf, lr, tau)
+    return (jax.tree_util.tree_unflatten(layout.treedef, new_p), p_out,
+            m_out, t_out, trust)
+
+
+def fused_lars_ema_update_resident_zero1(p_buf: jnp.ndarray,
+                                         flat_grads: Any,
+                                         m_buf: jnp.ndarray,
+                                         t_buf: jnp.ndarray, *,
+                                         layout: Any, mesh, lr, tau,
+                                         weight_decay: float,
+                                         momentum_decay: float,
+                                         trust_coefficient: float = lars_lib.TRUST_COEFFICIENT_DEFAULT,
+                                         eps: float = lars_lib.LARS_EPS_DEFAULT,
+                                         ema_pre: bool = False,
+                                         interpret: Optional[bool] = None):
+    """Fused update on fully RESIDENT ZeRO-1 buffers (``--flat-resident
+    on --zero1 on``).
+
+    ``p_buf`` (the param shadow), ``m_buf``, and ``t_buf`` are resident
+    ``(layout.global_size,)`` fp32 buffers sharded ``P(data)`` — each
+    device's contiguous chunk is exactly the shard-local packed buffer
+    the transient path built per step, so inside ``shard_map`` every chip
+    reshapes its chunk to ``(grid_rows, 128)`` (a bitcast, not a copy)
+    and runs the identical kernel core.  Only the GRADIENTS are packed
+    per step: ``flat_grads`` is the global flat-padded tree from
+    ``Zero1Context.shard`` (fresh autodiff leaves — the one unavoidable
+    pack).  Segment-norm partials psum over the data axis as in
+    :func:`fused_lars_ema_update_zero1`.  Returns ``(new_p_buf,
+    new_m_buf, new_t_buf, trust_vector)``, the buffers still sharded and
+    shape-identical to their inputs (the step-over-step donation alias).
+    """
+    interpret = _resolve_interpret(interpret)
+    seg, gr, br = layout.seg, layout.grid_rows, layout.block_rows
+    g_leaves = layout.treedef.flatten_up_to(flat_grads)
+
+    def local(p_b, g_l, m_b, t_b, lr_, tau_):
+        p_out, m_out, t_out, trust = _fused_update_buffers(
+            p_b.reshape(gr, _LANES), pack_flat(g_l, seg, gr),
+            m_b.reshape(gr, _LANES), t_b.reshape(gr, _LANES), lr_, tau_,
+            seg=seg, weight_decay=weight_decay,
+            momentum_decay=momentum_decay,
+            trust_coefficient=trust_coefficient, eps=eps, ema_pre=ema_pre,
+            axis_name=DATA_AXIS, block_rows=br, interpret=interpret)
+        return (p_out.reshape(-1), m_out.reshape(-1), t_out.reshape(-1),
+                trust)
+
+    sharded, rep = P(DATA_AXIS), P()
+    run = _shard_map(local, mesh,
+                     in_specs=(sharded, sharded, sharded, sharded, rep,
+                               rep),
+                     out_specs=(sharded, sharded, sharded, rep))
+    return run(p_buf, g_leaves, m_buf, t_buf, lr, tau)

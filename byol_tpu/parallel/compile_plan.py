@@ -44,7 +44,8 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from byol_tpu.parallel import zero1 as zero1_lib
+from byol_tpu.parallel import flat_state, zero1 as zero1_lib
+from byol_tpu.parallel.flat_state import FlatResidentContext
 from byol_tpu.parallel.mesh import DATA_AXIS
 from byol_tpu.parallel.partitioning import _path_names, state_shardings
 from byol_tpu.parallel.zero1 import ZERO1_STATE_FIELDS, Zero1Context
@@ -76,12 +77,19 @@ class CompilePlan:
 
     mesh: Mesh
     zero1: bool = False
-    # Templates derived by prepare_state (zero1 only): the canonical
-    # (replicated, shaped) and flat (padded 1-D) skeletons of the sharded
-    # state fields, used by the in-graph gather and the checkpoint codec.
+    # --flat-resident on: momentum / EMA target / (zero1) param shadow live
+    # as resident flat fp32 buffers (parallel/flat_state.py) packed once in
+    # prepare_state; bucket_mb sizes the coalesced gather's all-gathers.
+    flat_resident: bool = False
+    bucket_mb: int = flat_state.DEFAULT_BUCKET_MB
+    # Templates derived by prepare_state (zero1/flat_resident): the
+    # canonical (replicated, shaped) and flat (padded 1-D) skeletons of the
+    # converted state fields, used by the in-graph gather and the
+    # checkpoint codec.
     _param_template: Any = None
     _canon_templates: Any = None     # {field: canonical template tree}
     _flat_templates: Any = None      # {field: flat template tree}
+    _flat_layout: Any = None         # FlatLayout (flat_resident only)
 
     # -- shardings ---------------------------------------------------------
     @property
@@ -109,10 +117,15 @@ class CompilePlan:
             return base
         n = self.num_shards
         sharded = NamedSharding(self.mesh, P(DATA_AXIS))
+        # the resident param shadow is a sharded flat buffer like the
+        # zero1 opt_state/target leaves (it only exists under zero1 +
+        # flat_resident; the replicated-resident buffers stay replicated)
+        fields = ZERO1_STATE_FIELDS + (
+            ("flat_shadow",) if self.flat_resident else ())
 
         def spec_for(path, leaf, cur):
             names = _path_names(path)
-            if (names and names[0] in ZERO1_STATE_FIELDS
+            if (names and names[0] in fields
                     and getattr(leaf, "ndim", 0) == 1
                     and leaf.shape[0] % n == 0):
                 return sharded
@@ -154,9 +167,40 @@ class CompilePlan:
             # train step donates the state (training/state._dedupe_buffers)
             from byol_tpu.training.state import _dedupe_buffers
             state = _dedupe_buffers(state)
+        if self.flat_resident:
+            if self._param_template is None:
+                # replicated resident plan: derive the canonical templates
+                # the zero1 branch would have (the codec + gather need them)
+                self._param_template = jax.tree_util.tree_map(
+                    _struct_of, state.params)
+                self._canon_templates = {
+                    "opt_state": jax.eval_shape(tx.init,
+                                                self._param_template),
+                    "target_params": self._param_template,
+                }
+            self._flat_layout = flat_state.build_layout(
+                self._param_template,
+                self.num_shards if self.zero1 else 1)
+            state = self._pack_resident(state)
         sharding = self.state_sharding(state)
         state = jax.device_put(state, sharding)
         return state, sharding
+
+    def _pack_resident(self, state: Any) -> Any:
+        """The ONE pack: momentum trace, EMA target, and (zero1) the param
+        shadow become resident flat buffers.  pack_tree is idempotent over
+        the zero1 global flat leaves, so this runs identically after either
+        layout branch above."""
+        from byol_tpu.optim.factory import (extract_sgdm_state,
+                                            replace_sgdm_state)
+        lay = self._flat_layout
+        trace, count = extract_sgdm_state(state.opt_state)
+        return state.replace(
+            opt_state=replace_sgdm_state(
+                state.opt_state, flat_state.pack_tree(trace, lay), count),
+            target_params=flat_state.pack_tree(state.target_params, lay),
+            flat_shadow=(flat_state.pack_tree(state.params, lay)
+                         if self.zero1 else None))
 
     def _require_prepared(self, what: str) -> None:
         if self._param_template is None:
@@ -173,6 +217,16 @@ class CompilePlan:
         self._require_prepared("zero1_context()")
         return Zero1Context(mesh=self.mesh, num_shards=self.num_shards,
                             param_template=self._param_template)
+
+    def flat_context(self) -> Optional[FlatResidentContext]:
+        """The in-graph resident-buffer helper (bucketed gather + layout)
+        for the step builders; ``None`` when ``--flat-resident off`` — the
+        builders then trace the transient graph byte-identically."""
+        if not self.flat_resident:
+            return None
+        self._require_prepared("flat_context()")
+        return FlatResidentContext(mesh=self.mesh, layout=self._flat_layout,
+                                   bucket_mb=self.bucket_mb)
 
     # -- jit wiring: the six entry points ----------------------------------
     def jit_train_step(self, fn: Callable, state_sharding: Any):
@@ -232,21 +286,42 @@ class CompilePlan:
         """Plan layout -> the mesh-size-portable checkpoint layout
         (unflattened, replicated).  Identity when the plan is replicated,
         so ``--zero1 off`` checkpoints exactly as before — and a ckpt
-        written either way restores under either flag and any device
-        count."""
-        if not self.zero1:
+        written either way restores under either flag, any device count,
+        and either ``--flat-resident`` setting."""
+        if not (self.zero1 or self.flat_resident):
             return state
         self._require_prepared("to_canonical()")
-        state = self._convert(state, self._canon_templates, self.num_shards)
+        if self.flat_resident:
+            state = self._unpack_resident(state)
+        elif self.zero1:
+            state = self._convert(state, self._canon_templates,
+                                  self.num_shards)
         return jax.device_put(
             state, jax.tree_util.tree_map(lambda _: self.replicated, state))
 
+    def _unpack_resident(self, state: Any) -> Any:
+        """Resident buffers -> shaped canonical trees (the shadow is
+        dropped: canonical ``params`` already carries those values)."""
+        from byol_tpu.optim.factory import (extract_sgdm_state,
+                                            replace_sgdm_state)
+        lay = self._flat_layout
+        trace, count = extract_sgdm_state(state.opt_state)
+        return state.replace(
+            opt_state=replace_sgdm_state(
+                state.opt_state, flat_state.unpack_tree(trace, lay), count),
+            target_params=flat_state.unpack_tree(state.target_params, lay),
+            flat_shadow=None)
+
     def from_canonical(self, state: Any) -> Any:
         """Canonical (restored) layout -> plan layout, placed on the mesh."""
-        if not self.zero1:
+        if not (self.zero1 or self.flat_resident):
             return state
         self._require_prepared("from_canonical()")
-        state = self._convert(state, self._flat_templates, self.num_shards)
+        if self.flat_resident:
+            state = self._pack_resident(state)
+        elif self.zero1:
+            state = self._convert(state, self._flat_templates,
+                                  self.num_shards)
         return jax.device_put(state, self.state_sharding(state))
 
     def canonical_template(self, state: Any) -> Any:
@@ -254,7 +329,7 @@ class CompilePlan:
         from the canonical templates, everything placed replicated.  Pure
         metadata — the stored templates already carry the canonical shapes,
         so no concrete flat->canonical conversion of the live state runs."""
-        if not self.zero1:
+        if not (self.zero1 or self.flat_resident):
             return state
         self._require_prepared("canonical_template()")
         rep = self.replicated
@@ -264,6 +339,11 @@ class CompilePlan:
                                         sharding=rep)
         canon = state.replace(
             **{f: self._canon_templates[f] for f in ZERO1_STATE_FIELDS})
+        if self.flat_resident:
+            # the live opt_state holds the resident buffer in TraceState;
+            # restore targets the canonical shaped chain, shadow excluded
+            # (checkpoints are layout-agnostic: None fields have no leaves)
+            canon = canon.replace(flat_shadow=None)
         return jax.tree_util.tree_map(abstract, canon)
 
     # -- provenance --------------------------------------------------------
@@ -278,23 +358,37 @@ class CompilePlan:
             "axis_names": [str(a) for a in self.mesh.axis_names],
             "zero1": "on" if self.zero1 else "off",
             "donate_argnums": {k: list(v) for k, v in DONATE.items()},
+            "flat_resident": "on" if self.flat_resident else "off",
+            "flat_bucket_mb": int(self.bucket_mb),
         }
 
 
-def build_plan(mesh: Mesh, *, zero1: bool = False) -> CompilePlan:
-    """The one constructor: cfg.device.zero1 == 'on' -> a ZeRO-1 plan.
+def build_plan(mesh: Mesh, *, zero1: bool = False,
+               flat_resident: bool = False,
+               bucket_mb: int = flat_state.DEFAULT_BUCKET_MB) -> CompilePlan:
+    """The one constructor: cfg.device.zero1 == 'on' -> a ZeRO-1 plan,
+    cfg.device.flat_resident == 'on' -> resident flat update-state buffers.
 
     ZeRO-1 shards over the ``data`` axis only; combining it with tensor
     parallelism would need TP-aware flat layouts (the opt-state leaves of
     a TP-sharded kernel live sharded over ``model`` already) — rejected at
-    config resolve(), re-checked here for programmatic callers.
+    config resolve(), re-checked here for programmatic callers.  The
+    resident layout inherits the same restriction (its buffers are laid
+    out by the same data-axis segment maps).
     """
     if zero1 and mesh.shape.get("model", 1) > 1:
         raise ValueError(
             "zero1='on' is data-parallel weight-update sharding; it does "
             "not compose with model_parallel > 1 (the TP rules in "
             "partitioning.py already shard those opt-state leaves)")
-    return CompilePlan(mesh=mesh, zero1=zero1)
+    if flat_resident and mesh.shape.get("model", 1) > 1:
+        raise ValueError(
+            "flat_resident='on' lays the update state out over the data "
+            "axis; it does not compose with model_parallel > 1")
+    if bucket_mb < 1:
+        raise ValueError(f"bucket_mb must be >= 1, got {bucket_mb}")
+    return CompilePlan(mesh=mesh, zero1=zero1, flat_resident=flat_resident,
+                       bucket_mb=bucket_mb)
 
 
 def jit_encoder_extractor(fn: Callable):

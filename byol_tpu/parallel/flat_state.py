@@ -1,0 +1,247 @@
+"""Resident flat update-state layout: pack once at setup, carve per step.
+
+The fused weight update (ops/fused_update.py) computes on flat segmented
+fp32 buffers, but before ``--flat-resident on`` those buffers were
+TRANSIENT: every step re-packed the LARS momentum, the EMA target, and
+(under ZeRO-1) the param shards from their per-leaf trees — a concatenate
+feeding an opaque Pallas custom call that XLA cannot elide — and sliced
+the results back out, while ``Zero1Context.gather`` rebuilt replicated
+trees with one small all-gather PER LEAF (~leaf-count latency-bound
+collectives per step for the params, and again for the EMA target).  This
+module makes the flat layout the layout the state LIVES in across steps:
+
+- :class:`FlatLayout`: the static shape of one resident buffer — a
+  shard-major 1-D fp32 array of ``num_shards`` contiguous chunks, each
+  chunk laid out by the SAME shard-local :class:`~byol_tpu.ops.
+  fused_update.SegmentMap` the fused kernel walks, grid-tail padding
+  included (baked at build time so a resident buffer is consumable by the
+  kernel as-is, no per-step re-padding copy).  ``num_shards=1`` is the
+  replicated layout: one chunk whose segment map equals the global one,
+  so both ``--zero1`` settings share every function below.
+- :func:`pack_tree` / :func:`unpack_tree`: the setup/checkpoint codec
+  between shaped canonical trees and resident buffers.  Pack runs ONCE at
+  ``prepare_state`` (and at restore); it is also idempotent over the
+  global flat-padded 1-D leaves of parallel/zero1.py, because
+  ``flatten_leaf`` is a no-op on an already-padded flat leaf.
+- :func:`plan_buckets` + :meth:`FlatResidentContext.gather_tree`: the
+  bucketed all-gather replacing the per-leaf one.  The buffer viewed as
+  ``(num_shards, local_size)`` is cut into contiguous leaf-aligned column
+  buckets of at most ``bucket_mb`` MiB (gathered bytes), each constrained
+  replicated in ONE piece — one ``all-gather`` per bucket in the lowered
+  HLO (pinned by tests/test_flat_state.py) — and the shaped leaves are
+  carved out of the replicated buckets by slice+reshape, which XLA can
+  elide.  With ``num_shards == 1`` there is no collective at all: the
+  gather degenerates to the pure carve.
+
+Numerics are unchanged by construction: a shard's resident chunk is
+byte-identical to the shard-local buffer the per-step pack used to build
+(``flatten_leaf`` + row padding + grid tail, all zeros, all inert under
+the kernel's norms and elementwise update — the padding invariant of
+parallel/zero1.py), so ``--flat-resident on`` matches ``off`` to fp
+tolerance at every step (tests/test_flat_state.py pins <= 1e-5).
+
+PartitionSpecs constructed here name only the ``data`` axis (GL107:
+sharding decisions live in parallel/); the Pallas kernels stay in
+byol_tpu/ops/ (GL109) — this module only lays out and moves buffers.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from byol_tpu.ops.common import LANES, resolve_block_rows, resolve_interpret
+from byol_tpu.ops.fused_update import (SegmentMap, _adapted_flags,
+                                       build_segment_map)
+from byol_tpu.parallel import zero1 as zero1_lib
+from byol_tpu.parallel.mesh import DATA_AXIS
+
+# Default bucket budget for the coalesced gather: large enough that a
+# ResNet-50-sized fp32 tree (~100 MiB) gathers in a handful of
+# collectives, small enough that the gather pipeline never stages the
+# whole tree twice.
+DEFAULT_BUCKET_MB = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatLayout:
+    """Static geometry of one resident flat buffer.
+
+    ``seg`` is the SHARD-LOCAL segment map (leaf i owns ``seg.sizes[i] =
+    padded_size(leaf_size, num_shards) / num_shards`` elements per chunk,
+    row-padded to ``seg.padded[i]``); the buffer is ``num_shards`` such
+    chunks back to back, each chunk grid-tail-padded to ``grid_rows``
+    rows of ``LANES`` lanes so the fused kernel's tiling is part of the
+    layout, not a per-step copy.  Under ZeRO-1 the buffer is sharded
+    ``P(data)`` and each device holds exactly its chunk; with
+    ``num_shards == 1`` the single chunk IS the replicated global layout.
+    """
+
+    num_shards: int
+    seg: SegmentMap
+    treedef: Any
+    templates: Tuple[jax.ShapeDtypeStruct, ...]
+    block_rows: int
+    grid_rows: int
+
+    @property
+    def local_size(self) -> int:
+        """Elements per shard chunk (grid-tail padding included)."""
+        return self.grid_rows * LANES
+
+    @property
+    def global_size(self) -> int:
+        return self.num_shards * self.local_size
+
+    def struct(self) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct((self.global_size,), jnp.float32)
+
+
+def build_layout(param_template: Any, num_shards: int, *,
+                 block_rows: Optional[int] = None,
+                 interpret: Optional[bool] = None) -> FlatLayout:
+    """Derive the resident layout from the shaped parameter templates.
+
+    Pure function of the canonical shapes, the shard count, and the grid
+    sizing (``resolve_block_rows`` — deterministic per backend), so every
+    consumer (setup pack, per-step kernel, checkpoint codec, bucketed
+    gather) rebuilds the identical layout and can never disagree about
+    where a leaf lives.
+    """
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    leaves, treedef = jax.tree_util.tree_flatten(param_template)
+    templates = tuple(
+        jax.ShapeDtypeStruct(tuple(l.shape), l.dtype) for l in leaves)
+    seg = build_segment_map(
+        [zero1_lib.local_flat_size(t, num_shards) for t in templates],
+        _adapted_flags(templates))
+    br = resolve_block_rows(seg.num_rows, resolve_interpret(interpret),
+                            block_rows)
+    grid_rows = -(-seg.num_rows // br) * br
+    return FlatLayout(num_shards=num_shards, seg=seg, treedef=treedef,
+                      templates=templates, block_rows=br,
+                      grid_rows=grid_rows)
+
+
+def _leaf_list(tree: Any, layout: FlatLayout) -> List[Any]:
+    return layout.treedef.flatten_up_to(tree)
+
+
+def pack_tree(tree: Any, layout: FlatLayout) -> jnp.ndarray:
+    """Shaped (or globally-flat) tree -> one resident ``(global_size,)``
+    fp32 buffer.  Runs once at setup / checkpoint restore — never in the
+    hot path (the whole point of residency)."""
+    n = layout.num_shards
+    cols = []
+    for leaf, local, padded in zip(_leaf_list(tree, layout),
+                                   layout.seg.sizes, layout.seg.padded):
+        # flatten_leaf is idempotent on already-flat-padded leaves, so the
+        # ZeRO-1 global flat trees pack identically to canonical ones.
+        flat = zero1_lib.flatten_leaf(
+            jnp.asarray(leaf).astype(jnp.float32), n)
+        col = flat.reshape(n, local)
+        if padded != local:
+            col = jnp.pad(col, ((0, 0), (0, padded - local)))
+        cols.append(col)
+    mat = jnp.concatenate(cols, axis=1)
+    tail = layout.local_size - layout.seg.total
+    if tail:
+        mat = jnp.pad(mat, ((0, 0), (0, tail)))
+    return mat.reshape(-1)
+
+
+def _carve_leaf(window: jnp.ndarray, layout: FlatLayout,
+                i: int) -> jnp.ndarray:
+    """Shaped leaf i out of its ``(num_shards, sizes[i])`` column window:
+    slice + reshape + pad drop, all XLA-elidable (no copies)."""
+    tmpl = layout.templates[i]
+    local = layout.seg.sizes[i]
+    size = math.prod(tmpl.shape) if tmpl.shape else 1
+    return (window.reshape(layout.num_shards * local)[:size]
+            .reshape(tmpl.shape).astype(tmpl.dtype))
+
+
+def unpack_tree(buf: jnp.ndarray, layout: FlatLayout) -> Any:
+    """Resident buffer -> the shaped canonical tree (padding dropped)."""
+    mat = jnp.asarray(buf).reshape(layout.num_shards, layout.local_size)
+    leaves = [
+        _carve_leaf(mat[:, start:start + local], layout, i)
+        for i, (start, local) in enumerate(zip(layout.seg.starts,
+                                               layout.seg.sizes))]
+    return jax.tree_util.tree_unflatten(layout.treedef, leaves)
+
+
+def plan_buckets(layout: FlatLayout,
+                 bucket_mb: int) -> Tuple[Tuple[int, int, Tuple[int, ...]],
+                                          ...]:
+    """Greedy contiguous leaf-aligned column buckets of <= ``bucket_mb``
+    MiB GATHERED bytes each; a single oversized leaf gets its own bucket
+    (never split — the carve needs whole segments).  Returns
+    ``((col_start, col_end, leaf_indices), ...)`` over the ``(num_shards,
+    local_size)`` view; static layout data, computed at trace time.
+    """
+    if bucket_mb < 1:
+        raise ValueError(f"bucket_mb must be >= 1, got {bucket_mb}")
+    budget = bucket_mb * (1 << 20)
+    bytes_per_col = layout.num_shards * 4          # fp32 columns
+    buckets = []
+    cur: List[int] = []
+    cur_start = 0
+    for i, (start, padded) in enumerate(zip(layout.seg.starts,
+                                            layout.seg.padded)):
+        end = start + padded
+        if cur and (end - cur_start) * bytes_per_col > budget:
+            buckets.append((cur_start, layout.seg.starts[cur[-1]]
+                            + layout.seg.padded[cur[-1]], tuple(cur)))
+            cur, cur_start = [], start
+        cur.append(i)
+    if cur:
+        buckets.append((cur_start, layout.seg.starts[cur[-1]]
+                        + layout.seg.padded[cur[-1]], tuple(cur)))
+    return tuple(buckets)
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatResidentContext:
+    """In-graph helper the step builders close over under ``--flat-resident
+    on`` (built by the compile plan, which owns every sharding decision);
+    ``None`` in the builders means the non-resident graph — the off flag
+    traces byte-identical HLO (tests/test_flat_state.py)."""
+
+    mesh: Mesh
+    layout: FlatLayout
+    bucket_mb: int = DEFAULT_BUCKET_MB
+
+    def buckets(self) -> Tuple[Tuple[int, int, Tuple[int, ...]], ...]:
+        return plan_buckets(self.layout, self.bucket_mb)
+
+    def gather_tree(self, buf: jnp.ndarray) -> Any:
+        """Resident buffer -> replicated shaped tree, one all-gather per
+        BUCKET (vs one per leaf in ``Zero1Context.gather``).  With one
+        shard there is no collective: the carve is pure slice+reshape.
+        """
+        lay = self.layout
+        n = lay.num_shards
+        mat = buf.reshape(n, lay.local_size)
+        if n > 1:
+            # pin the shard-major view to its natural layout, then lift
+            # each bucket to replicated in ONE piece — the bucket's
+            # all-gather — before carving leaves from the replicated block
+            mat = jax.lax.with_sharding_constraint(
+                mat, NamedSharding(self.mesh, P(DATA_AXIS, None)))
+        rep = NamedSharding(self.mesh, P())
+        leaves: List[Any] = [None] * lay.seg.num_segments
+        for col0, col1, idxs in self.buckets():
+            blk = mat[:, col0:col1]
+            if n > 1:
+                blk = jax.lax.with_sharding_constraint(blk, rep)
+            for i in idxs:
+                window = blk[:, lay.seg.starts[i] - col0:
+                             lay.seg.starts[i] - col0 + lay.seg.sizes[i]]
+                leaves[i] = _carve_leaf(window, lay, i)
+        return jax.tree_util.tree_unflatten(lay.treedef, leaves)

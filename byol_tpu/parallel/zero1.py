@@ -178,7 +178,14 @@ class Zero1Context:
 
     def gather(self, flat_tree: Any, template: Any) -> Any:
         """All-gather flat shards back to the replicated, shaped tree —
-        just-in-time for a forward pass (params, EMA target)."""
+        just-in-time for a forward pass (params, EMA target).
+
+        One small all-gather PER LEAF (~leaf-count latency-bound
+        collectives per tree).  ``--flat-resident on`` replaces this with
+        the bucketed gather over ONE resident buffer —
+        :meth:`byol_tpu.parallel.flat_state.FlatResidentContext.
+        gather_tree`, a handful of <= bucket_mb MiB all-gathers with the
+        leaves carved out by slice+reshape."""
         rep = self._replicated()
         return jax.tree_util.tree_map(
             lambda f, t: unflatten_leaf(

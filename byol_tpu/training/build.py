@@ -138,7 +138,8 @@ def step_config(rcfg: ResolvedConfig) -> StepConfig:
         aug_seed=cfg.device.seed,
         telemetry=cfg.device.telemetry,
         weight_decay=cfg.regularizer.weight_decay,
-        lars_in_chain=is_lars_optimizer(cfg.optim.optimizer))
+        lars_in_chain=is_lars_optimizer(cfg.optim.optimizer),
+        flat_resident=cfg.device.flat_resident == "on")
 
 
 def _validate_remat_tags(net, rcfg: ResolvedConfig, variables,
@@ -190,7 +191,9 @@ def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array,
     scfg = step_config(rcfg)
     from byol_tpu.parallel.compile_plan import build_plan
     if plan is None:
-        plan = build_plan(mesh, zero1=cfg.device.zero1 == "on")
+        plan = build_plan(mesh, zero1=cfg.device.zero1 == "on",
+                          flat_resident=cfg.device.flat_resident == "on",
+                          bucket_mb=cfg.device.flat_bucket_mb)
 
     from byol_tpu.core.rng import split_named
     keys = split_named(rng, ("params", "weight_init"))
@@ -227,6 +230,7 @@ def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array,
     # momentum/EMA), places it, and owns the jit wiring of both steps.
     state, state_sh = plan.prepare_state(state, tx)
     z1 = plan.zero1_context()
+    fctx = plan.flat_context()
 
     # lr_schedule + mesh feed ONLY the fused-kernel paths (fused_update
     # needs the bare lr value; both fused kernels need a mesh for their
@@ -234,9 +238,11 @@ def setup_training(rcfg: ResolvedConfig, mesh: Mesh, rng: jax.Array,
     # graph is unchanged.
     train_step = plan.jit_train_step(
         make_train_step(net, tx, scfg, policy, zero1_ctx=z1,
-                        lr_schedule=schedule, mesh=mesh), state_sh)
+                        lr_schedule=schedule, mesh=mesh, flat_ctx=fctx),
+        state_sh)
     eval_step = plan.jit_eval_step(
-        make_eval_step(net, scfg, policy, zero1_ctx=z1), state_sh)
+        make_eval_step(net, scfg, policy, zero1_ctx=z1, flat_ctx=fctx),
+        state_sh)
 
     def _with_mesh(fn):
         # keep the mesh in thread-local scope at call (=trace) time so

@@ -185,6 +185,17 @@ class StepConfig:
                                          # one as "applied" would be
                                          # fiction (LAMB's internal ratio
                                          # is not surfaced here)
+    flat_resident: bool = False          # --flat-resident on: momentum /
+                                         # EMA target / (zero1) the param
+                                         # shadow live as resident flat
+                                         # buffers (parallel/flat_state
+                                         # .py); the step consumes and
+                                         # produces them in place and the
+                                         # gathers run bucketed.  Requires
+                                         # fused_update and a flat_ctx.
+                                         # False traces the exact transient
+                                         # graph (HLO identity pinned by
+                                         # tests/test_flat_state.py)
 
 
 def _forward_views(net, params, batch_stats, aug1, aug2, *, train: bool,
@@ -252,7 +263,7 @@ def augment_keys(seed: int, step, k: int) -> jnp.ndarray:
 
 def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
                     policy: Policy = FP32, zero1_ctx=None,
-                    lr_schedule=None, mesh=None
+                    lr_schedule=None, mesh=None, flat_ctx=None
                     ) -> Callable[[TrainState, Dict[str, jnp.ndarray]],
                                   Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Build the jittable train step: (state, batch) -> (state, metrics).
@@ -286,6 +297,18 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
     ``mesh`` (the kernel runs under shard_map; GSPMD cannot partition a
     pallas_call).  False leaves the traced graph byte-identical to the
     pre-fused-update step.
+
+    ``flat_ctx`` (parallel.flat_state.FlatResidentContext, from the compile
+    plan): ``--flat-resident on``.  The LARS momentum, the EMA target, and
+    (with ``zero1_ctx``) the param shadow arrive as RESIDENT flat fp32
+    buffers packed once at setup; the step reshapes them straight into the
+    fused kernel (no per-step pack/unpack — only fresh gradients still
+    pack), writes them back shape- and sharding-identical (the jit state
+    donation aliases them step over step), and every target/param gather
+    runs BUCKETED (one all-gather per <= bucket_mb MiB contiguous bucket
+    instead of one per leaf).  ``None`` traces the transient graph
+    unchanged (``--flat-resident off`` HLO identity,
+    tests/test_flat_state.py).
 
     ``scfg.fused_augment`` swaps the in-step two-view augmentation
     (``augment_in_step``) for the fused Pallas kernel
@@ -328,6 +351,21 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
             raise ValueError(
                 "fused_update=True requires lr_schedule (the schedule tx "
                 "closes over; the fused kernel needs the bare lr value)")
+    if scfg.flat_resident:
+        if not scfg.fused_update:
+            raise ValueError(
+                "flat_resident=True requires fused_update=True: the "
+                "resident buffers are laid out for (and consumed by) the "
+                "fused kernel — the optax chain has no flat entry point")
+        if flat_ctx is None:
+            raise ValueError(
+                "flat_resident=True requires flat_ctx (the compile plan's "
+                "FlatResidentContext — build the plan with "
+                "flat_resident=True)")
+    elif flat_ctx is not None:
+        raise ValueError(
+            "flat_ctx passed but scfg.flat_resident is False: the plan "
+            "and the step config disagree about the state layout")
     if scfg.fused_augment:
         # config resolve() rejects these at the CLI; re-checked for
         # programmatic callers handing a StepConfig straight to the builder
@@ -490,7 +528,15 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
     def train_step(state: TrainState, batch):
         labels = batch["label"]
         k = scfg.accum_steps
-        if zero1_ctx is not None:
+        if flat_ctx is not None:
+            # Resident layout: the EMA target is ONE flat buffer (sharded
+            # under zero1, replicated otherwise); rebuild the shaped tree
+            # just-in-time with the bucketed gather — a handful of
+            # coalesced all-gathers instead of one per leaf (and with one
+            # shard, a pure carve with no collective at all).
+            micro_state = state.replace(
+                target_params=flat_ctx.gather_tree(state.target_params))
+        elif zero1_ctx is not None:
             # ZeRO-1: the EMA target arrives flat-sharded; gather it
             # just-in-time for the target forwards.  The microbatch paths
             # read the target off the state they are handed, so hand them
@@ -537,7 +583,36 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
             tau = cosine_ema_decay(state.ema_step, scfg.total_train_steps,
                                    scfg.base_decay)
             ema_pre = scfg.ema_update_mode == "reference_pre"
-            if zero1_ctx is None:
+            if flat_ctx is not None and zero1_ctx is None:
+                # resident replicated: momentum + target stay flat buffers
+                # end to end; params/grads (shaped forward inputs / fresh
+                # autodiff outputs) pack inside the kernel entry — the one
+                # remaining per-step pack.  new_shadow is the kernel's own
+                # packed view of the fresh params, kept for telemetry.
+                new_params, new_shadow, new_trace, new_target, \
+                    fused_trust = fused_lib.fused_lars_ema_update_resident(
+                        state.params, grads, trace, state.target_params,
+                        layout=flat_ctx.layout, lr=fused_lr, tau=tau,
+                        weight_decay=scfg.weight_decay,
+                        momentum_decay=factory_lib.MOMENTUM_DECAY,
+                        ema_pre=ema_pre, mesh=mesh)
+            elif flat_ctx is not None:
+                # resident ZeRO-1: the param shadow, momentum, and target
+                # are all resident sharded buffers — each chip reshapes
+                # its chunk straight into the kernel (zero pack/unpack);
+                # only the fresh gradients scatter+pack, and the fresh
+                # params come back via the bucketed gather.
+                flat_grads = zero1_ctx.shard(grads)
+                new_shadow, new_trace, new_target, fused_trust = \
+                    fused_lib.fused_lars_ema_update_resident_zero1(
+                        state.flat_shadow, flat_grads, trace,
+                        state.target_params, layout=flat_ctx.layout,
+                        mesh=zero1_ctx.mesh, lr=fused_lr, tau=tau,
+                        weight_decay=scfg.weight_decay,
+                        momentum_decay=factory_lib.MOMENTUM_DECAY,
+                        ema_pre=ema_pre)
+                new_params = flat_ctx.gather_tree(new_shadow)
+            elif zero1_ctx is None:
                 new_params, new_trace, new_target, fused_trust = \
                     fused_lib.fused_lars_ema_update(
                         state.params, grads, trace, state.target_params,
@@ -655,9 +730,15 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
             # Under ZeRO-1 the target tree is flat-sharded, so the drift
             # subtraction needs the params in the SAME layout; zero
             # padding contributes nothing to any norm, so every reported
-            # value is identical to the replicated step's.
-            health_params = (new_params if zero1_ctx is None
-                             else new_params_flat)
+            # value is identical to the replicated step's.  Under the
+            # resident layout the target is ONE flat buffer, so the health
+            # vector reads the kernel's own packed params buffer — the
+            # resident layout's segment norms, no shaped recompute.
+            if flat_ctx is not None:
+                health_params = new_shadow
+            else:
+                health_params = (new_params if zero1_ctx is None
+                                 else new_params_flat)
             metrics["health"] = health_lib.health_stats(
                 grads=grads, updates=updates, params=health_params,
                 target_params=new_target, loss=metrics["loss_mean"],
@@ -672,20 +753,27 @@ def make_train_step(net, tx: optax.GradientTransformation, scfg: StepConfig,
             opt_state=new_opt_state,
             polyak_params=new_polyak,
         )
+        if flat_ctx is not None and zero1_ctx is not None:
+            # the fresh shadow buffer rides the state (same shape, same
+            # sharding as the one donated in) — next step reshapes it
+            # straight into the kernel again
+            new_state = new_state.replace(flat_shadow=new_shadow)
         return new_state, metrics
 
     return train_step
 
 
 def make_eval_step(net, scfg: StepConfig, policy: Policy = FP32,
-                   zero1_ctx=None):
+                   zero1_ctx=None, flat_ctx=None):
     """Eval step per reference semantics (main.py:574-606, §3.3): full BYOL
     loss computed in eval too; probe sees only view-1 representations with
     un-doubled labels (main.py:250-251); EMA frozen; BN uses running stats;
     Polyak params used for prediction when enabled (main.py:585-587).
 
     ``zero1_ctx``: as in :func:`make_train_step` — the flat-sharded EMA
-    target is all-gathered just-in-time for the target forward."""
+    target is all-gathered just-in-time for the target forward.
+    ``flat_ctx``: the resident layout's bucketed gather takes over that
+    rebuild (eval and linear-eval share the train step's coalescing)."""
 
     def eval_step(state: TrainState, batch):
         aug1 = policy.cast_to_compute(batch["view1"])
@@ -704,7 +792,9 @@ def make_eval_step(net, scfg: StepConfig, policy: Policy = FP32,
             params = state.polyak_params
 
         target_params = state.target_params
-        if zero1_ctx is not None:
+        if flat_ctx is not None:
+            target_params = flat_ctx.gather_tree(target_params)
+        elif zero1_ctx is not None:
             target_params = zero1_ctx.gather(target_params,
                                              zero1_ctx.param_template)
 

@@ -1,0 +1,24 @@
+"""GL104 fixture: resident-buffer reuse-after-donate (must fire).
+
+Under ``--flat-resident on`` the flat momentum/target/shadow buffers ride
+the donated state argument, so donating the state kills every resident
+buffer reachable from it.  Holding last step's ``state.flat_shadow`` on
+the host (for telemetry, a debug dump, ...) after the donating call reads
+a buffer XLA already reused in place.
+"""
+import jax
+
+
+def step_fn(state, batch):
+    return state, {}
+
+
+train_step = jax.jit(step_fn, donate_argnums=(0,))
+
+
+def loop_with_shadow_probe(state, batches, sink):
+    for batch in batches:
+        new_state, metrics = train_step(state, batch)  # donates state
+        sink.offer(state.flat_shadow)   # dead: the resident buffer rode
+        state = new_state               # the donated state argument
+    return state

@@ -1,0 +1,13 @@
+"""Fixture: a plan whose resident train entry donates arg 0 — the
+resident flat buffers (momentum/target/shadow) live inside that state,
+so GL113 must see the donation through the builder indirection."""
+import jax
+
+DONATE = {
+    "train_step": (0,),
+}
+
+
+class Plan:
+    def jit_train_step(self, fn):
+        return jax.jit(fn, donate_argnums=DONATE["train_step"])

@@ -1,0 +1,15 @@
+"""Fixture: the driver holds a host reference to last step's resident
+flat buffer across the donating call — ``shadow`` aliases the donated
+state's buffer, and so does the direct ``state.flat_shadow`` read after
+the donation (the cross-module resident reuse-after-donate)."""
+from .wiring import train_step
+
+
+def train(state, batches, sink):
+    history = []
+    for batch in batches:
+        new_state, metrics = train_step(state, batch)  # donates state
+        sink.offer(state.flat_shadow)  # GL113: resident buffer is dead
+        state = new_state
+        history.append(metrics)
+    return state, history

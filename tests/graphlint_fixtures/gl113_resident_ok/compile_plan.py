@@ -1,0 +1,16 @@
+"""Fixture near-miss plan: same shape as gl113_resident_bad — donating
+resident train entry plus a read-only eval entry."""
+import jax
+
+DONATE = {
+    "train_step": (0,),
+    "eval_step": (),
+}
+
+
+class Plan:
+    def jit_train_step(self, fn):
+        return jax.jit(fn, donate_argnums=DONATE["train_step"])
+
+    def jit_eval_step(self, fn):
+        return jax.jit(fn)

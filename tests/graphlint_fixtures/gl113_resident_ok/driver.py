@@ -1,0 +1,20 @@
+"""Fixture near-miss driver: the resident buffer is probed from the
+STEP OUTPUT after the rebind — a fresh buffer, never an alias of the
+donated input — and the non-donating eval entry reads state freely."""
+from .wiring import eval_step, train_step
+
+
+def train(state, batches, sink):
+    history = []
+    for batch in batches:
+        state, metrics = train_step(state, batch)   # rebind over donation
+        sink.offer(state.flat_shadow)   # this step's OUTPUT buffer: fine
+        history.append(metrics)
+    return state, history
+
+
+def evaluate(state, batches):
+    out = []
+    for batch in batches:
+        out.append(eval_step(state, batch))   # state read-only: no donation
+    return state, out

@@ -1,0 +1,12 @@
+"""Fixture near-miss wiring: binds both resident entry points."""
+from .compile_plan import Plan
+
+plan = Plan()
+
+
+def _step(state, batch):
+    return state, batch
+
+
+train_step = plan.jit_train_step(_step)
+eval_step = plan.jit_eval_step(_step)

@@ -1,0 +1,21 @@
+"""GL104 near-miss: resident buffer read from the STEP OUTPUT (clean).
+
+The legal way to observe the resident flat buffers is through the fresh
+state the donating step returns — after the rebind, ``state.flat_shadow``
+is this step's output buffer, never an alias of the donated input.
+"""
+import jax
+
+
+def step_fn(state, batch):
+    return state, {}
+
+
+train_step = jax.jit(step_fn, donate_argnums=(0,))
+
+
+def loop_with_shadow_probe(state, batches, sink):
+    for batch in batches:
+        state, metrics = train_step(state, batch)   # rebind over donation
+        sink.offer(state.flat_shadow)   # fresh output buffer: fine
+    return state

@@ -1,0 +1,401 @@
+"""Resident flat update state (ISSUE 18 tentpole).
+
+The contracts under test:
+
+- **Parity** (acceptance): ``--flat-resident on`` matches the transient
+  fused path's loss, eval loss, and post-step params / LARS momentum /
+  EMA target within 1e-5 at accum 1 AND 2, zero1 off AND on, on the
+  8-virtual-device CPU mesh, every step under the ``guard_steps``
+  transfer-guard fixture — residency is a layout change, not a math
+  change (a shard's resident chunk is byte-identical to the shard-local
+  buffer the per-step pack built, parallel/flat_state.py docstring).
+- **Off-identity** (acceptance): ``--flat-resident off`` lowers
+  byte-identical HLO to a step built with no resident plumbing at all —
+  the flag, the ``flat_ctx`` builder kwarg, and the StepConfig field
+  change NOTHING until switched on; and ``on`` really traces a different
+  program (the gate is live).
+- **Bucketed gather** (satellite): the per-leaf ``Zero1Context.gather``
+  lowers ~leaf-count all-gather ops; ``FlatResidentContext.gather_tree``
+  lowers <= bucket-count — the coalescing claim, falsified by counting
+  ``all-gather`` instructions in compiled HLO on CPU.
+- **Layout units**: pack/unpack round-trips exactly for 1 and N shards,
+  pack is idempotent over the ZeRO-1 global flat layout, bucket plans
+  tile the row exactly within budget, and the resident buffer's padding
+  is all zeros (the norm-inertness every parity claim rests on).
+"""
+import dataclasses
+import math
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from byol_tpu.core import config as config_lib
+from byol_tpu.parallel import flat_state as flat_lib
+from byol_tpu.parallel import zero1 as zero1_lib
+from byol_tpu.parallel.compile_plan import build_plan
+from byol_tpu.parallel.mesh import DATA_AXIS, shard_batch_to_mesh
+from byol_tpu.parallel.zero1 import Zero1Context
+from byol_tpu.training.build import setup_training
+from tests.conftest import guard_steps, tree_maxdiff as _tree_maxdiff
+
+BATCH = 16
+IMAGE = 16
+
+ALL_GATHER_RE = re.compile(r"= \S+ all-gather\(")
+
+
+def _rcfg(resident="off", zero1="off", accum=1):
+    c = config_lib.Config()
+    c = c.replace(
+        task=dataclasses.replace(c.task, batch_size=BATCH, epochs=2,
+                                 image_size_override=IMAGE),
+        model=dataclasses.replace(c.model, arch="resnet18",
+                                  head_latent_size=32, projection_size=16),
+        optim=dataclasses.replace(c.optim, warmup=1, lr=0.1,
+                                  accum_steps=accum, fused_update="on"),
+        device=dataclasses.replace(c.device, num_replicas=8, half=False,
+                                   zero1=zero1, flat_resident=resident),
+    )
+    return config_lib.resolve(c, num_train_samples=64, num_test_samples=16,
+                              output_size=10, input_shape=(IMAGE, IMAGE, 3),
+                              representation_size=512)
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "view1": rng.rand(BATCH, IMAGE, IMAGE, 3).astype(np.float32),
+        "view2": rng.rand(BATCH, IMAGE, IMAGE, 3).astype(np.float32),
+        "label": rng.randint(0, 10, size=(BATCH,)).astype(np.int32),
+    }
+
+
+def _plan_for(mesh, rcfg):
+    cfg = rcfg.cfg
+    return build_plan(mesh, zero1=cfg.device.zero1 == "on",
+                      flat_resident=cfg.device.flat_resident == "on",
+                      bucket_mb=cfg.device.flat_bucket_mb)
+
+
+def _run_arm(mesh, resident, zero1="off", accum=1, n=2):
+    """n guarded train steps + one guarded eval from the seed-0 init;
+    returns (plan, plan-layout state, CANONICAL state, metrics, eval
+    loss).  The eval step exercises the bucketed EMA-target gather on the
+    resident arm (the eval/linear-eval coalescing satellite)."""
+    rcfg = _rcfg(resident=resident, zero1=zero1, accum=accum)
+    plan = _plan_for(mesh, rcfg)
+    net, state, train_step, eval_step, _ = setup_training(
+        rcfg, mesh, jax.random.PRNGKey(0), plan=plan)
+    train_step = guard_steps(train_step)
+    metrics = None
+    for i in range(n):
+        batch = shard_batch_to_mesh(_batch(seed=i), mesh)
+        state, metrics = train_step(state, batch)
+    ev = guard_steps(eval_step)(state,
+                                shard_batch_to_mesh(_batch(seed=99), mesh))
+    return (plan, state, plan.to_canonical(state),
+            {k: float(v) for k, v in metrics.items()},
+            float(ev["loss_mean"]))
+
+
+# ---------------------------------------------------------------------------
+# parity: resident == transient, accum 1/2 x zero1 off/on  (acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("zero1", ["off", "on"])
+@pytest.mark.parametrize("accum", [1, 2])
+def test_resident_matches_transient(mesh8, zero1, accum):
+    _, _, canon_off, m_off, ev_off = _run_arm(mesh8, "off", zero1=zero1,
+                                              accum=accum)
+    _, raw_on, canon_on, m_on, ev_on = _run_arm(mesh8, "on", zero1=zero1,
+                                                accum=accum)
+
+    # the resident arm really is resident: momentum + target are single
+    # 1-D fp32 buffers, and under zero1 they (and the shadow) shard over
+    # 'data' while params stay replicated shaped for the forward
+    from byol_tpu.optim.factory import extract_sgdm_state
+    trace, _ = extract_sgdm_state(raw_on.opt_state)
+    assert isinstance(trace, jax.Array) and trace.ndim == 1
+    assert isinstance(raw_on.target_params, jax.Array)
+    assert raw_on.target_params.shape == trace.shape
+    if zero1 == "on":
+        assert raw_on.flat_shadow is not None
+        assert raw_on.flat_shadow.shape == trace.shape
+        for buf in (trace, raw_on.target_params, raw_on.flat_shadow):
+            assert DATA_AXIS in str(buf.sharding.spec)
+    else:
+        assert raw_on.flat_shadow is None
+    assert all(leaf.ndim > 0 or True for leaf in
+               jax.tree_util.tree_leaves(raw_on.params))
+
+    for k in m_off:
+        np.testing.assert_allclose(
+            m_on[k], m_off[k], rtol=1e-5,
+            err_msg=f"metric {k} @ zero1={zero1} accum={accum}")
+    np.testing.assert_allclose(ev_on, ev_off, rtol=1e-5)
+
+    # post-step state in the canonical (shaped, replicated) view
+    assert _tree_maxdiff(canon_off.params, canon_on.params) < 1e-5
+    assert _tree_maxdiff(canon_off.opt_state, canon_on.opt_state) < 1e-5
+    assert _tree_maxdiff(canon_off.target_params,
+                         canon_on.target_params) < 1e-5
+    assert canon_on.flat_shadow is None      # canonical ckpts carry none
+    assert int(canon_on.step) == int(canon_off.step) == 2
+
+
+# ---------------------------------------------------------------------------
+# --flat-resident off HLO identity + on lowers a different program
+# ---------------------------------------------------------------------------
+
+def test_resident_off_lowers_identical_hlo(mesh8):
+    """The off arm's program must be byte-identical to a fused step built
+    with NO resident plumbing at all — make_train_step called exactly as
+    the pre-resident code called it (no flat_ctx kwarg)."""
+    from byol_tpu.core.precision import get_policy
+    from byol_tpu.parallel.partitioning import state_shardings
+    from byol_tpu.training.build import build_net, build_tx, step_config
+    from byol_tpu.training.steps import make_train_step
+
+    rcfg = _rcfg(resident="off")
+    plan = _plan_for(mesh8, rcfg)
+    net, state, train_step, _, _ = setup_training(
+        rcfg, mesh8, jax.random.PRNGKey(0), plan=plan)
+    batch = shard_batch_to_mesh(_batch(), mesh8)
+    with mesh8:
+        off_text = train_step.__wrapped__.lower(state, batch).as_text()
+
+    tx, schedule = build_tx(rcfg)
+    bare = jax.jit(
+        make_train_step(build_net(rcfg), tx, step_config(rcfg),
+                        get_policy(False), lr_schedule=schedule,
+                        mesh=mesh8),
+        in_shardings=(state_shardings(state, mesh8),
+                      NamedSharding(mesh8, P(DATA_AXIS))),
+        out_shardings=(state_shardings(state, mesh8),
+                       NamedSharding(mesh8, P())),
+        donate_argnums=(0,))
+    with mesh8:
+        bare_text = bare.lower(state, batch).as_text()
+    assert off_text == bare_text
+
+
+def test_resident_on_lowers_a_different_program(mesh8):
+    texts = {}
+    for resident in ("off", "on"):
+        rcfg = _rcfg(resident=resident)
+        plan = _plan_for(mesh8, rcfg)
+        _, state, train_step, _, _ = setup_training(
+            rcfg, mesh8, jax.random.PRNGKey(0), plan=plan)
+        batch = shard_batch_to_mesh(_batch(), mesh8)
+        with mesh8:
+            texts[resident] = train_step.__wrapped__.lower(
+                state, batch).as_text()
+    assert texts["on"] != texts["off"]
+
+
+# ---------------------------------------------------------------------------
+# bucketed gather: all-gather count <= buckets, not leaves  (satellite)
+# ---------------------------------------------------------------------------
+
+def _toy_template():
+    """~6 leaves, sizes chosen so a small bucket budget splits them into
+    several buckets (sizes in fp32 elements per shard after padding)."""
+    shapes = {"conv": (3, 3, 8, 16), "bn_scale": (16,), "bn_bias": (16,),
+              "dense": (128, 64), "dense_bias": (64,), "probe": (64, 10)}
+    return {k: jax.ShapeDtypeStruct(v, jnp.float32)
+            for k, v in shapes.items()}
+
+
+def _count_all_gathers(compiled_text):
+    return len(ALL_GATHER_RE.findall(compiled_text))
+
+
+def test_bucketed_gather_coalesces_collectives(mesh8):
+    """Per-leaf gather: ~one all-gather per leaf.  Bucketed gather: at
+    most one per bucket.  Counted in the compiled HLO, so the coalescing
+    claim is falsifiable on CPU — the acceptance criterion."""
+    n = len(mesh8.devices.flat)
+    tmpl = _toy_template()
+    n_leaves = len(jax.tree_util.tree_leaves(tmpl))
+    layout = flat_lib.build_layout(tmpl, n)
+    # tiny budget: every bucket is 1 KiB of gathered bytes -> >1 bucket,
+    # but still far fewer than leaves after coalescing the small ones
+    ctx = flat_lib.FlatResidentContext(mesh=mesh8, layout=layout,
+                                       bucket_mb=1)
+    n_buckets = len(ctx.buckets())
+    assert 1 <= n_buckets < n_leaves
+
+    z1 = Zero1Context(mesh=mesh8, num_shards=n, param_template=tmpl)
+    rng = np.random.RandomState(0)
+    tree = {k: jnp.asarray(rng.rand(*t.shape).astype(np.float32))
+            for k, t in tmpl.items()}
+    flat_tree = jax.device_put(
+        jax.jit(z1.shard)(tree),
+        jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh8, P(DATA_AXIS)), tmpl))
+    buf = jax.device_put(flat_lib.pack_tree(tree, layout),
+                         NamedSharding(mesh8, P(DATA_AXIS)))
+
+    with mesh8:
+        per_leaf = jax.jit(
+            lambda t: z1.gather(t, tmpl)).lower(flat_tree).compile()
+        bucketed = jax.jit(ctx.gather_tree).lower(buf).compile()
+    count_leafwise = _count_all_gathers(per_leaf.as_text())
+    count_bucketed = _count_all_gathers(bucketed.as_text())
+    assert count_leafwise >= n_leaves // 2   # ~one per leaf (XLA may fold)
+    assert 1 <= count_bucketed <= n_buckets
+    assert count_bucketed < count_leafwise
+
+    # and the bucketed gather is CORRECT, not just cheap
+    with mesh8:
+        gathered = jax.jit(ctx.gather_tree)(buf)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(gathered[k]),
+                                      np.asarray(tree[k]))
+
+
+# ---------------------------------------------------------------------------
+# layout units: pack/unpack, idempotency, buckets, padding
+# ---------------------------------------------------------------------------
+
+class TestFlatLayout:
+    @pytest.mark.parametrize("n", [1, 8])
+    def test_pack_unpack_roundtrip(self, n):
+        tmpl = _toy_template()
+        layout = flat_lib.build_layout(tmpl, n)
+        rng = np.random.RandomState(1)
+        tree = {k: jnp.asarray(rng.rand(*t.shape).astype(np.float32))
+                for k, t in tmpl.items()}
+        buf = flat_lib.pack_tree(tree, layout)
+        assert buf.shape == (layout.global_size,)
+        assert layout.global_size == n * layout.grid_rows * 128
+        back = flat_lib.unpack_tree(buf, layout)
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(back[k]),
+                                          np.asarray(tree[k]))
+
+    def test_pack_padding_is_zero(self):
+        """Row padding, shard-remainder padding, and the grid tail are all
+        zeros — the inertness the parity claims rest on."""
+        tmpl = _toy_template()
+        layout = flat_lib.build_layout(tmpl, 8)
+        ones = {k: jnp.ones(t.shape, jnp.float32)
+                for k, t in tmpl.items()}
+        buf = np.asarray(flat_lib.pack_tree(ones, layout))
+        total_real = sum(
+            math.prod(t.shape) for t in jax.tree_util.tree_leaves(tmpl))
+        assert int(buf.sum()) == total_real          # everything else is 0
+        assert int((buf == 1.0).sum()) == total_real
+
+    def test_pack_is_idempotent_over_zero1_flat_layout(self):
+        """Packing the ZeRO-1 global flat tree (what prepare_state holds
+        after the zero1 branch) must produce the SAME buffer as packing
+        the shaped canonical tree — the single-pack setup path relies on
+        it."""
+        n = 8
+        tmpl = _toy_template()
+        layout = flat_lib.build_layout(tmpl, n)
+        rng = np.random.RandomState(2)
+        tree = {k: jnp.asarray(rng.rand(*t.shape).astype(np.float32))
+                for k, t in tmpl.items()}
+        flat_tree = {k: zero1_lib.flatten_leaf(v, n)
+                     for k, v in tree.items()}
+        np.testing.assert_array_equal(
+            np.asarray(flat_lib.pack_tree(tree, layout)),
+            np.asarray(flat_lib.pack_tree(flat_tree, layout)))
+
+    def test_buckets_tile_the_row_within_budget(self):
+        layout = flat_lib.build_layout(_toy_template(), 8)
+        for mb in (1, 64):
+            buckets = flat_lib.plan_buckets(layout, mb)
+            # contiguous, ordered, leaf-aligned cover of all segments
+            assert buckets[0][0] == 0
+            seen = []
+            for (c0, c1, idxs), nxt in zip(buckets, buckets[1:] + ((None,) * 3,)):
+                assert c0 < c1
+                assert c1 == (layout.seg.starts[idxs[-1]]
+                              + layout.seg.padded[idxs[-1]])
+                if nxt[0] is not None:
+                    assert nxt[0] == c1
+                seen.extend(idxs)
+            assert seen == list(range(layout.seg.num_segments))
+            # every multi-leaf bucket respects the budget (a single
+            # oversized leaf may exceed it; it is never split)
+            budget = mb * (1 << 20)
+            for c0, c1, idxs in buckets:
+                if len(idxs) > 1:
+                    assert (c1 - c0) * layout.num_shards * 4 <= budget
+        # large budget degenerates to one bucket
+        assert len(flat_lib.plan_buckets(layout, 1 << 10)) == 1
+
+    def test_single_shard_gather_has_no_collective(self, mesh8):
+        """num_shards == 1: gather_tree is a pure carve — zero all-gather
+        ops in the compiled HLO."""
+        tmpl = _toy_template()
+        layout = flat_lib.build_layout(tmpl, 1)
+        ctx = flat_lib.FlatResidentContext(mesh=mesh8, layout=layout)
+        rng = np.random.RandomState(3)
+        tree = {k: jnp.asarray(rng.rand(*t.shape).astype(np.float32))
+                for k, t in tmpl.items()}
+        buf = flat_lib.pack_tree(tree, layout)
+        with mesh8:
+            compiled = jax.jit(ctx.gather_tree).lower(buf).compile()
+            gathered = jax.jit(ctx.gather_tree)(buf)
+        assert _count_all_gathers(compiled.as_text()) == 0
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(gathered[k]),
+                                          np.asarray(tree[k]))
+
+    def test_build_layout_rejects_bad_args(self):
+        with pytest.raises(ValueError, match="num_shards"):
+            flat_lib.build_layout(_toy_template(), 0)
+        layout = flat_lib.build_layout(_toy_template(), 1)
+        with pytest.raises(ValueError, match="bucket_mb"):
+            flat_lib.plan_buckets(layout, 0)
+
+
+# ---------------------------------------------------------------------------
+# gating + provenance
+# ---------------------------------------------------------------------------
+
+class TestGating:
+    def test_resolve_rejects_resident_without_fused(self):
+        c = config_lib.Config()
+        c = c.replace(device=dataclasses.replace(c.device,
+                                                 flat_resident="on"))
+        with pytest.raises(ValueError, match="fused-update"):
+            config_lib.resolve(c, num_train_samples=64,
+                               num_test_samples=16, output_size=10,
+                               input_shape=(IMAGE, IMAGE, 3),
+                               representation_size=512)
+
+    def test_make_train_step_rejects_inconsistent_wiring(self):
+        from byol_tpu.training.build import build_net, build_tx, step_config
+        rcfg = _rcfg(resident="on")
+        scfg = step_config(rcfg)
+        assert scfg.flat_resident
+        net = build_net(rcfg)
+        tx, schedule = build_tx(rcfg)
+        from byol_tpu.training.steps import make_train_step
+        with pytest.raises(ValueError, match="flat_ctx"):
+            make_train_step(net, tx, scfg, lr_schedule=schedule)
+        bad = dataclasses.replace(scfg, fused_update=False,
+                                  flat_resident=True)
+        with pytest.raises(ValueError, match="fused_update"):
+            make_train_step(net, tx, bad, lr_schedule=schedule)
+
+    def test_build_plan_rejects_small_bucket(self, mesh8):
+        with pytest.raises(ValueError, match="bucket_mb"):
+            build_plan(mesh8, flat_resident=True, bucket_mb=0)
+
+
+def test_plan_describe_carries_resident_fields(mesh8):
+    d = build_plan(mesh8, zero1=True, flat_resident=True,
+                   bucket_mb=32).describe()
+    assert d["flat_resident"] == "on"
+    assert d["flat_bucket_mb"] == 32
+    assert build_plan(mesh8).describe()["flat_resident"] == "off"

@@ -56,6 +56,11 @@ RULE_CASES = [
     ("GL101", "xmod_host_sync_bad", "xmod_host_sync_ok"),
     ("GL112", "gl112_plan_bad", "gl112_plan_ok"),
     ("GL113", "gl113_flow_bad", "gl113_flow_ok"),
+    # ISSUE 18: --flat-resident buffers ride the donated state — holding
+    # last step's state.flat_shadow on the host after the donating call
+    # is the resident shape of use-after-donate, local and cross-module
+    ("GL104", "bad_resident_reuse.py", "ok_resident_reuse.py"),
+    ("GL113", "gl113_resident_bad", "gl113_resident_ok"),
 ]
 
 
