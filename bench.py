@@ -152,6 +152,17 @@ _GMACS = {
 # reports live MFU from the same source, observability/flops.py).
 from byol_tpu.observability.flops import chip_peak_tflops as _chip_peak_tflops
 
+# Strict-JSON output contract (GL110): every JSON line/file this script
+# emits goes through the event sink's sanitize + allow_nan=False path,
+# so an anomalous run (NaN loss, inf step time) still prints parseable
+# JSON instead of bare NaN/Infinity tokens.
+from byol_tpu.observability.events import sanitize as _sanitize_json
+
+
+def _json_line(obj) -> str:
+    return json.dumps(_sanitize_json(obj), allow_nan=False)
+
+
 
 def _flops_per_sample(arch: str, image_size: int) -> float | None:
     gmacs = _GMACS.get((arch, image_size))
@@ -492,7 +503,8 @@ def _flush_partial():
             # next flush, never fall through to truncating the evidence
             _flushed_paths.add(_PARTIAL_PATH)
         with open(_PARTIAL_PATH, "w") as f:
-            json.dump(_partial, f, indent=2)
+            json.dump(_sanitize_json(_partial), f, indent=2,
+                      allow_nan=False)
             f.write("\n")
     except OSError as e:  # read-only fs must not kill the measurement
         print(f"bench: could not write {_PARTIAL_PATH}: {e}", file=sys.stderr)
@@ -578,7 +590,7 @@ def _emit_stale_or_die() -> None:
             "matmul succeeds.")
     arch = prior.get("arch", "resnet50")
     value = best["images_per_sec_per_chip"]
-    print(json.dumps({
+    print(_json_line({
         "metric": f"{arch}_byol_train_images_per_sec_per_chip",
         "value": value,
         "unit": "images/sec/chip",
@@ -754,7 +766,7 @@ def main():
         for stem in ("conv", "space_to_depth"):
             val = best_throughput(f"stem_{stem}", half=True, fuse_views=True,
                                   ema_update_mode="post", stem=stem)
-            print(json.dumps({"metric": f"stem_ab_{stem}",
+            print(_json_line({"metric": f"stem_ab_{stem}",
                               "value": round(val, 2) if val else None,
                               "unit": "images/sec/chip",
                               "vs_baseline": None,
@@ -967,7 +979,7 @@ def _print_headline(arch, value, baseline, bf16_ref, mfu_of, note=None):
         if baseline is not None:
             out["dtype_gain"] = round(bf16_ref / baseline, 3)
         out["redesign_gain"] = round(value / bf16_ref, 3)
-    print(json.dumps(out))
+    print(_json_line(out))
 
 
 def _profile(arch, image_size, candidates, logdir):
@@ -1010,7 +1022,7 @@ def _profile(arch, image_size, candidates, logdir):
         state, metrics = train_step(state, batch)
     float(metrics["loss_mean"])                 # readback inside the trace
     jax.profiler.stop_trace()
-    print(json.dumps({"metric": "profile", "value": bs,
+    print(_json_line({"metric": "profile", "value": bs,
                       "unit": "batch/chip", "vs_baseline": None,
                       "logdir": logdir}))
 
@@ -1086,7 +1098,7 @@ def _data_pipeline_bench():
         jpeg_rates = None
 
     primary = rates.get("native", rates["tf"])
-    print(json.dumps({
+    print(_json_line({
         "metric": "host_data_pipeline_images_per_sec",
         "value": round(primary, 1),
         "unit": "images/sec/host",
@@ -1271,7 +1283,7 @@ def _dry_compile(arch, image_size, on_tpu, attn_impl):
     compiled, stats = _aot_compile(train_step, state, batch, mesh)
     del compiled
     hbm = stats.get("hbm_high_water_bytes")
-    print(json.dumps({
+    print(_json_line({
         "metric": "dry_compile_hbm_high_water_bytes",
         "value": hbm,
         "unit": "bytes",
@@ -1474,7 +1486,7 @@ def _accum_ladder(arch, image_size, on_tpu, mfu_of, attn_impl, gates):
         print(f"bench: {name}: {float(val):.1f} img/s/chip "
               f"compile={row.get('compile_seconds')}s "
               f"hbm={row.get('hbm_high_water_bytes')}", file=sys.stderr)
-    print(json.dumps({"metric": "accum_ladder", "value": len(rungs),
+    print(_json_line({"metric": "accum_ladder", "value": len(rungs),
                       "unit": "rungs", "vs_baseline": None,
                       "microbatch_per_chip": mb, "remat_policy": policy,
                       "rungs": rungs,
@@ -1550,7 +1562,7 @@ def _input_ladder(arch, image_size, on_tpu, mfu_of, attn_impl, gates):
         print(f"bench: {name}: {float(val):.1f} img/s/chip "
               f"h2d={row.get('h2d_bytes_per_step')} "
               f"hbm={row.get('hbm_high_water_bytes')}", file=sys.stderr)
-    print(json.dumps({"metric": "input_ladder", "value": len(rungs),
+    print(_json_line({"metric": "input_ladder", "value": len(rungs),
                       "unit": "rungs", "vs_baseline": None,
                       "microbatch_per_chip": mb, "remat_policy": policy,
                       "rungs": rungs,
@@ -1604,7 +1616,7 @@ def _telemetry_ab(arch, image_size, on_tpu, attn_impl):
         print(f"bench: telemetry_{mode}: {rates[mode]:.1f} img/s/chip",
               file=sys.stderr)
     overhead = 1.0 - rates["step"] / rates["off"]
-    print(json.dumps({
+    print(_json_line({
         "metric": "telemetry_step_overhead_pct",
         "value": round(100.0 * overhead, 2),
         "unit": "%",
@@ -1730,7 +1742,7 @@ def _spans_ab(arch, image_size, on_tpu, attn_impl):
               f"(reps {[round(r, 2) for r in rates[mode]]})",
               file=sys.stderr)
     overhead = 1.0 - med["on"] / med["off"]
-    print(json.dumps({
+    print(_json_line({
         "metric": "spans_overhead_pct",
         "value": round(100.0 * overhead, 2),
         "unit": "%",
@@ -1807,7 +1819,7 @@ def _zero1_ab(arch, image_size, on_tpu, attn_impl):
         # either arm missing the column degrades the ratio, not the run
         if off_b and on_b:
             ratio = round(on_b / off_b, 4)
-    print(json.dumps({
+    print(_json_line({
         "metric": "zero1_ab_optimizer_state_bytes",
         "value": rows.get("on", {}).get("optimizer_state_bytes"),
         "unit": "bytes/chip",
@@ -1901,7 +1913,8 @@ def _fused_ab(arch, image_size, on_tpu, attn_impl):
         lambda p: jnp.asarray(rng.standard_normal(p.shape) * 0.01,
                               jnp.float32), params)
     target = jax.tree_util.tree_map(lambda p: p * 0.9, params)
-    wd, tau = 1e-6, jnp.float32(0.99)
+    wd = 1e-6
+    tau = 0.99
     tx, sched = build_optimizer(
         "lars_momentum", base_lr=0.2, global_batch_size=4096,
         weight_decay=wd, total_units=100, warmup_units=10)
@@ -1946,7 +1959,7 @@ def _fused_ab(arch, image_size, on_tpu, attn_impl):
     }
     _record("fused_microbench", fit=True, **row)
     overhead = 1.0 - rates["on"] / rates["off"]
-    print(json.dumps({
+    print(_json_line({
         "metric": "fused_update_ab",
         "value": round(rates["on"], 2),
         "unit": "images/sec/chip",
@@ -2038,7 +2051,8 @@ def _resident_ab(arch, image_size, on_tpu, attn_impl):
         lambda p: jnp.asarray(rng.standard_normal(p.shape) * 0.01,
                               jnp.float32), params)
     target = jax.tree_util.tree_map(lambda p: p * 0.9, params)
-    wd, tau = 1e-6, jnp.float32(0.99)
+    wd = 1e-6
+    tau = 0.99
     tx, sched = build_optimizer(
         "lars_momentum", base_lr=0.2, global_batch_size=4096,
         weight_decay=wd, total_units=100, warmup_units=10)
@@ -2082,7 +2096,7 @@ def _resident_ab(arch, image_size, on_tpu, attn_impl):
         "interpret_mode": not on_tpu,
     }
     _record("resident_microbench", fit=True, **row)
-    print(json.dumps({
+    print(_json_line({
         "metric": "flat_resident_ab",
         "value": round(rates["on"], 2),
         "unit": "images/sec/chip",
@@ -2185,6 +2199,7 @@ def _augment_ab(arch, image_size, on_tpu, attn_impl):
         return float(np.median(times))
 
     t_chain = bench_fn(xla_chain, (key, imgs))
+    # graphlint: disable=GL103 -- A/B arms deliberately consume the same key: the fused kernel must see the XLA chain's exact random draws
     t_fused = bench_fn(fused, (key, imgs))
     row = {
         "batch": bs,
@@ -2196,7 +2211,7 @@ def _augment_ab(arch, image_size, on_tpu, attn_impl):
     }
     _record("augment_microbench", fit=True, **row)
     overhead = 1.0 - rates["on"] / rates["off"]
-    print(json.dumps({
+    print(_json_line({
         "metric": "fused_augment_ab",
         "value": round(rates["on"], 2),
         "unit": "images/sec/chip",
@@ -2372,7 +2387,7 @@ def _serve_ladder(arch, image_size, on_tpu, attn_impl):
                       f"recompiles {recompiles}", file=sys.stderr)
         finally:
             service.stop()
-    print(json.dumps({
+    print(_json_line({
         "metric": "serve_ladder_p99_ms",
         "value": ladder[-1]["p99_ms"] if ladder else None,
         "unit": "ms @ most-concurrent rung",
@@ -2495,7 +2510,7 @@ def _wire_ladder(arch, image_size, on_tpu, attn_impl):
         for c in clients.values():
             c.close()
         server.drain(grace_s=0.0, timeout_s=60.0)   # stops the service
-    print(json.dumps({
+    print(_json_line({
         "metric": "wire_ladder_p50_tax_ms",
         "value": (round(ladder[-1]["p50_ms"] - ladder[-2]["p50_ms"], 3)
                   if len(ladder) >= 2 else None),
@@ -2624,7 +2639,8 @@ def _sweep(arch, image_size, candidates, mfu_of):
                 # partial re-run must never destroy a complete prior table
                 os.replace(sweep_path, sweep_path + ".prev")
             with open(sweep_path, "w") as f:
-                json.dump(rows, f, indent=2)
+                json.dump(_sanitize_json(rows), f, indent=2,
+                          allow_nan=False)
                 f.write("\n")
         except OSError as e:  # same contract as _flush_partial
             print(f"bench: could not write {sweep_path}: {e}",
@@ -2632,7 +2648,7 @@ def _sweep(arch, image_size, candidates, mfu_of):
     else:
         print(f"bench: no rows measured; leaving {sweep_path} untouched",
               file=sys.stderr)
-    print(json.dumps({"metric": "sweep", "value": len(rows),
+    print(_json_line({"metric": "sweep", "value": len(rows),
                       "unit": "configs", "vs_baseline": None,
                       "complete": not _backend_dead}))
     if _backend_dead:

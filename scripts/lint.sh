@@ -1,7 +1,10 @@
 #!/usr/bin/env bash
-# Static-analysis gate: graphlint over the shipped byol_tpu/ tree AND
-# over tools/graphlint/ itself (self-hosting, ISSUE 17: the linter must
-# hold to its own rules — GL103 name hygiene, GL110 strict JSON, ...).
+# Static-analysis gate: graphlint over the shipped byol_tpu/ tree, over
+# tools/graphlint/ itself (self-hosting, ISSUE 17: the linter must hold
+# to its own rules — GL103 name hygiene, GL110 strict JSON, ...), and
+# (wave 4, ISSUE 19) over the driver/tooling surface too: scripts/*.py,
+# bench.py, train.py — the files that print the evidence JSON and bind
+# the jitted entry points, where GL110/GL102-shaped bugs actually lived.
 #
 # Default run (no args) produces both outputs from ONE engine run:
 #   - human text on stdout (findings as path:line:col: RULE message),
@@ -35,7 +38,9 @@ export JAX_PLATFORMS=cpu
 if [ "$#" -eq 0 ]; then
     mkdir -p evidence
     exec python -m tools.graphlint byol_tpu/ tools/graphlint/ \
+        scripts/ bench.py train.py \
         --trend-baseline evidence/graphlint.json \
         --out evidence/graphlint.json
 fi
-exec python -m tools.graphlint byol_tpu/ tools/graphlint/ "$@"
+exec python -m tools.graphlint byol_tpu/ tools/graphlint/ \
+    scripts/ bench.py train.py "$@"

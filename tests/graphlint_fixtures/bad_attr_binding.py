@@ -1,0 +1,26 @@
+"""Fixture: the serving/engine.py:85 spelling — a jitted entry point
+bound as ``self._jitted = plan.jit_embed(fn)``.  ``jit_embed`` is a
+tracing FORWARDER (its param is staged via ``jax.jit`` in the body), so
+the module-level function passed at the binding site runs under a trace
+and its host sync must be flagged at the true definition site."""
+import time
+
+import jax
+
+
+def _represent(batch):
+    time.time()                       # GL101: host clock under trace
+    return batch
+
+
+class Plan:
+    def jit_embed(self, fn):
+        return jax.jit(fn, donate_argnums=(0,))
+
+
+class Engine:
+    def __init__(self, plan):
+        self._jitted = plan.jit_embed(_represent)   # the binding site
+
+    def embed(self, batch):
+        return self._jitted(batch)

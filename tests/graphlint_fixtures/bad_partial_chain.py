@@ -1,0 +1,19 @@
+"""Fixture: host sync inside a def staged for tracing through a REBOUND
+``functools.partial`` chain — wave-4 value flow (tools/graphlint/flow.py)
+must follow ``step = partial(step)`` back through the chain to the def
+and mark it traced."""
+import functools
+import time
+
+import jax
+
+
+def _step(state, scale):
+    time.time()                       # GL101: host clock under trace
+    return state
+
+
+def build():
+    step = functools.partial(_step, scale=2.0)
+    step = functools.partial(step)    # rebound chain hop
+    return jax.jit(step)

@@ -1,0 +1,22 @@
+"""Fixture: GL114 — an instance attribute mutated both on the spawned
+worker thread and in a public method, with no common lock on the two
+sites (the submit/close TOCTOU shape past PR reviews caught by hand)."""
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self._pending -= 1          # GL114: worker-side store
+
+    def submit(self, item):
+        self._pending += 1              # public-side store, no common lock
+        return item

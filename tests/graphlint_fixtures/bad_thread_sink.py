@@ -1,0 +1,26 @@
+"""Fixture: GL115 — non-thread-safe sinks (RunLog, open()-file) written
+from both the spawned worker thread and a public method with no common
+lock; interleaved writers corrupt the JSONL stream byte-wise."""
+import threading
+
+from byol_tpu.observability.events import RunLog
+
+
+class Telemetry:
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self.events = RunLog(path)
+        self._raw = open(path + ".txt", "a")
+        self._thread = threading.Thread(target=self._run)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self.events.emit("tick")        # GL115: RunLog, worker side
+            self._raw.write("tick\n")       # GL115: file, worker side
+
+    def record(self, name):
+        self.events.emit(name)              # public side, no common lock
+        self._raw.write(name + "\n")

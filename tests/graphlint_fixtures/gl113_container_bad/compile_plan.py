@@ -1,0 +1,12 @@
+"""Fixture: plan whose train entry donates arg 0 — the donation the
+wave-4 container flow must track through tuple/dict literals."""
+import jax
+
+DONATE = {
+    "train_step": (0,),
+}
+
+
+class Plan:
+    def jit_train_step(self, fn):
+        return jax.jit(fn, donate_argnums=DONATE["train_step"])

@@ -1,0 +1,23 @@
+"""Fixture: donated buffers riding in container literals — the wave-4
+value-flow arms of GL113.  The donation kills the NAME, and every
+container slot recorded as holding that name dies with it."""
+from .wiring import train_step
+
+
+def tuple_slot_reuse(state, batch):
+    bundle = (state, batch)
+    new_state, _ = train_step(state, batch)    # donates arg 0: state dead
+    return bundle[0], new_state                # GL113: dead tuple slot
+
+
+def dict_slot_reuse(state, batch):
+    ckpt = {"state": state, "batch": batch}
+    new_state, _ = train_step(state, batch)
+    return ckpt["state"], new_state            # GL113: dead dict slot
+
+
+def unpack_reuse(state, batch):
+    bundle = (state, batch)
+    new_state, _ = train_step(state, batch)
+    s, b = bundle                              # alias of the dead slot
+    return s, new_state                        # GL113: via tuple-unpack

@@ -1,0 +1,11 @@
+"""Fixture: binds the donating entry point at module level."""
+from .compile_plan import Plan
+
+plan = Plan()
+
+
+def _step(state, batch):
+    return state, batch
+
+
+train_step = plan.jit_train_step(_step)

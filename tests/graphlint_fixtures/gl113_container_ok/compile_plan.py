@@ -1,0 +1,11 @@
+"""Fixture near-miss plan: same shape as gl113_container_bad."""
+import jax
+
+DONATE = {
+    "train_step": (0,),
+}
+
+
+class Plan:
+    def jit_train_step(self, fn):
+        return jax.jit(fn, donate_argnums=DONATE["train_step"])

@@ -1,0 +1,23 @@
+"""Fixture near-miss: containers whose slots stay legal — literal built
+AFTER the rebinding donation, a container literal REBOUND over the stale
+one, and a NON-literal container (stands down, zero-false-positive)."""
+from .wiring import train_step
+
+
+def literal_after_rebind(state, batch):
+    state, _ = train_step(state, batch)     # result rebound over input
+    bundle = (state, batch)                 # holds the fresh buffer
+    return bundle[0]
+
+
+def container_rebound(state, batch):
+    ckpt = {"state": state}
+    new_state, _ = train_step(state, batch)
+    ckpt = {"state": new_state}             # slots dropped with the rebind
+    return ckpt["state"]
+
+
+def non_literal_stands_down(state, batch, pack):
+    bundle = pack(state, batch)             # opaque container: stand down
+    new_state, _ = train_step(state, batch)
+    return bundle[0], new_state

@@ -1,0 +1,34 @@
+"""Fixture near-miss (stand-down pins for the wave-4 forwarder arm):
+
+- the forwarder name is NOT unique project-wide (two classes define
+  ``jit_embed``), so the unresolvable-receiver fallback must stand down
+  even though the passed function contains host sync;
+- a forwarder invoked through ``**kwargs`` plumbing never resolves its
+  staged argument.
+"""
+import time
+
+import jax
+
+
+def _represent(batch):
+    time.time()       # never proven traced: receiver/kwargs stand down
+    return batch
+
+
+class PlanA:
+    def jit_embed(self, fn):
+        return jax.jit(fn)
+
+
+class PlanB:
+    def jit_embed(self, fn):
+        return fn                      # same name, different semantics
+
+
+class Engine:
+    def __init__(self, plan, cfg):
+        # receiver unresolvable + 'jit_embed' ambiguous project-wide
+        self._jitted = plan.jit_embed(_represent)
+        # **kwargs plumbing: the staged argument never resolves
+        self._other = plan.jit_embed(**cfg)

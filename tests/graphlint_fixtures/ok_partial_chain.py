@@ -1,0 +1,22 @@
+"""Fixture near-miss: the partial chain is BROKEN by an opaque call —
+the staged callable does not resolve statically, so the def's host sync
+must NOT be attributed to a trace (zero-false-positive stand-down)."""
+import functools
+import time
+
+import jax
+
+
+def _step(state, scale):
+    time.time()          # host-side is fine: _step is never proven traced
+    return state
+
+
+def _decorate(fn):
+    return fn
+
+
+def build():
+    step = functools.partial(_step, scale=2.0)
+    step = _decorate(step)            # opaque hop: chain stands down
+    return jax.jit(step)

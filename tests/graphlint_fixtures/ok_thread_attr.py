@@ -1,0 +1,44 @@
+"""Fixture near-miss for GL114:
+
+- both mutation sites hold the SAME ``with self._lock:`` guard (the
+  EmbeddingService discipline);
+- ``__init__`` stores happen before the thread exists and must not count
+  as a public side;
+- a class whose thread target is a LOCAL function (not ``self.<m>``)
+  stands down entirely.
+"""
+import threading
+
+
+class GuardedBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = 0               # pre-thread store: not an entry
+        self._thread = threading.Thread(target=self._run)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._pending -= 1
+
+    def submit(self, item):
+        with self._lock:
+            self._pending += 1
+        return item
+
+
+class LocalTargetStandsDown:
+    def __init__(self):
+        self._pending = 0
+
+        def worker():
+            self._pending -= 1
+
+        self._thread = threading.Thread(target=worker)
+
+    def submit(self, item):
+        self._pending += 1              # unguarded, but no self-target
+        return item

@@ -1,0 +1,33 @@
+"""Fixture near-miss for GL115:
+
+- every sink write (worker and public side) holds the same lock;
+- a sink only ever written from the worker thread is single-writer and
+  legal even without a lock;
+- attributes that are not recognized sink constructors never count.
+"""
+import threading
+
+from byol_tpu.observability.events import RunLog
+
+
+class GuardedTelemetry:
+    def __init__(self, path, transport):
+        self._lock = threading.Lock()
+        self.events = RunLog(path)
+        self._worker_log = open(path + ".txt", "a")
+        self._transport = transport          # opaque: not a sink
+        self._thread = threading.Thread(target=self._run)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.events.emit("tick")
+            self._worker_log.write("tick\n")  # worker-only: single writer
+
+    def record(self, name):
+        with self._lock:
+            self.events.emit(name)
+        self._transport.write(name)           # unresolvable sink: stand down

@@ -61,6 +61,17 @@ RULE_CASES = [
     # is the resident shape of use-after-donate, local and cross-module
     ("GL104", "bad_resident_reuse.py", "ok_resident_reuse.py"),
     ("GL113", "gl113_resident_bad", "gl113_resident_ok"),
+    # wave 4 (ISSUE 19): value-flow resolution — traced scope through
+    # rebound functools.partial chains and through attribute-bound
+    # forwarder results (the serving/engine.py:85 spelling); the ok
+    # twins pin the unresolvable-receiver and **kwargs stand-downs
+    ("GL101", "bad_partial_chain.py", "ok_partial_chain.py"),
+    ("GL101", "bad_attr_binding.py", "ok_attr_binding.py"),
+    # donated buffers riding tuple/dict literals + tuple-unpack aliasing
+    ("GL113", "gl113_container_bad", "gl113_container_ok"),
+    # host-concurrency lints over the threaded serving/input surface
+    ("GL114", "bad_thread_attr.py", "ok_thread_attr.py"),
+    ("GL115", "bad_thread_sink.py", "ok_thread_sink.py"),
 ]
 
 
@@ -277,7 +288,7 @@ class TestEngineSemantics:
         assert payload["clean"] is True
         assert payload["files_scanned"] == 1
         assert payload["findings"] == []
-        assert payload["schema_version"] == 3
+        assert payload["schema_version"] == 4
         assert payload["suppressions_by_rule"] == {}
         # schema v3: per-rule wall time (incl. the shared whole-program
         # pass under its own key) + resolution counters
@@ -290,6 +301,14 @@ class TestEngineSemantics:
                       "symbols_resolved", "symbols_unresolved",
                       "cross_module_traced"):
             assert isinstance(res[field], int)
+        # schema v4: the value-flow prepass is timed under its own key
+        # and its resolution counters land in a "flow" section
+        assert engine.FLOW_PASS in timing["rule_wall_seconds"]
+        fl = payload["flow"]
+        for field in ("partial_chains_resolved",
+                      "attribute_bindings_resolved", "forwarded_traced",
+                      "thread_classes_analyzed"):
+            assert isinstance(fl[field], int)
 
     def test_out_json_with_text_stdout(self, tmp_path):
         """One run, both reports: text on stdout, JSON at --out *.json —
@@ -510,6 +529,46 @@ class TestTrendAlarm:
         assert "--trend-baseline evidence/graphlint.json" in text
 
 
+class TestValueFlow:
+    """Wave-4 pins (ISSUE 19): the flow layer's resolution lands findings
+    at true definition sites and names the staging/binding site."""
+
+    def test_attr_binding_site_named(self):
+        """Acceptance: the serving/engine.py:85 spelling — an entry point
+        bound as self._jitted = plan.jit_embed(fn) — is analyzed as
+        traced, flagged at fn's DEFINITION with the binding site named."""
+        findings = run_rule(FIXTURES / "bad_attr_binding.py", "GL101")
+        assert len(findings) == 1
+        assert findings[0].line == 12            # the def, not the call
+        assert "jit_embed" in findings[0].message
+        assert "bad_attr_binding.py:23" in findings[0].message
+
+    def test_partial_chain_fires_at_definition(self):
+        findings = run_rule(FIXTURES / "bad_partial_chain.py", "GL101")
+        assert [f.line for f in findings] == [12]
+
+    def test_gl113_container_arms_all_fire(self):
+        """Tuple-literal slot, dict-literal slot, and tuple-unpack alias
+        each produce exactly one finding."""
+        findings = run_rule(FIXTURES / "gl113_container_bad", "GL113")
+        assert len(findings) == 3
+        msgs = " | ".join(f.message for f in findings)
+        assert "bundle[0]" in msgs
+        assert "ckpt['state']" in msgs
+
+    def test_gl114_names_both_sites_and_spawn(self):
+        findings = run_rule(FIXTURES / "bad_thread_attr.py", "GL114")
+        assert len(findings) == 1
+        m = findings[0].message
+        assert "'_run'" in m and "'submit'" in m and "spawned" in m
+
+    def test_gl115_flags_each_sink_once(self):
+        findings = run_rule(FIXTURES / "bad_thread_sink.py", "GL115")
+        assert len(findings) == 2                # RunLog + open()-file
+        msgs = " | ".join(f.message for f in findings)
+        assert "RunLog" in msgs and "open()-file" in msgs
+
+
 class TestTreeGate:
     def test_shipped_tree_lints_clean(self):
         """Acceptance: the shipped byol_tpu/ tree exits 0 through the SAME
@@ -538,6 +597,29 @@ class TestTreeGate:
         for rule_id in ("GL101", "GL102", "GL103", "GL104", "GL105",
                         "GL106", "GL001", "GL000"):
             assert rule_id in proc.stdout
+
+    def test_driver_surface_lints_clean(self):
+        """Wave-4 widened sweep (ISSUE 19): the driver/tooling surface —
+        scripts/*.py, bench.py, train.py — exits 0 through the same
+        entrypoint scripts/lint.sh now covers."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graphlint", "scripts/",
+             "bench.py", "train.py"],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, (
+            "graphlint found issues in the driver surface:\n"
+            + proc.stdout)
+
+    def test_full_widened_sweep_wall_budget(self):
+        """The full widened sweep (every root scripts/lint.sh runs,
+        value-flow prepass included) stays under the 60s wall budget."""
+        findings, _, stats = engine.run(
+            [str(REPO / p) for p in ("byol_tpu", "tools/graphlint",
+                                     "scripts", "bench.py", "train.py")],
+            all_rules())
+        assert findings == [], [f.message for f in findings]
+        assert stats.total_seconds <= 60.0, stats.rule_seconds
+        assert engine.FLOW_PASS in stats.rule_seconds
 
     def test_missing_path_exits_2(self):
         proc = subprocess.run(

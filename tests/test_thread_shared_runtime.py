@@ -1,0 +1,163 @@
+"""Runtime complement to graphlint GL114/GL115 (ISSUE 19).
+
+The static rules flag two host-concurrency shapes on the threaded
+serving/input surface; these tests prove each flagged exemplar is a REAL
+interleaving hazard — and that the lock discipline the rules demand
+actually removes it — mirroring the guard_steps/RematTagError precedent
+(every static check ships with a runtime demonstration of the bug it
+prevents).
+
+Interleavings are CHOREOGRAPHED with events/barriers, not scheduled by
+hammering: a single CPython ``f.write``/``+=`` is near-atomic under the
+GIL, so a naive two-thread loop can pass for hours while the race stays
+latent.  The choreography forces the exact interleaving the OS is
+allowed to produce, making both the failure and the fixed assertion
+deterministic.
+"""
+import threading
+
+import pytest
+
+from byol_tpu.observability.events import RunLog, read_events
+
+
+# ---------------------------------------------------------------- GL114
+class UnguardedBatcher:
+    """The bad_thread_attr.py exemplar: read-modify-write on a shared
+    instance attribute with no lock.  ``before_write`` exposes the window
+    between the read and the write so the test can park another thread's
+    update inside it."""
+
+    def __init__(self):
+        self.pending = 0
+
+    def increment(self, before_write=None):
+        v = self.pending
+        if before_write is not None:
+            before_write()
+        self.pending = v + 1
+
+
+class GuardedBatcher:
+    """The ok_thread_attr.py fix: the SAME read-modify-write under one
+    common lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = 0
+
+    def increment(self, before_write=None):
+        with self._lock:
+            v = self.pending
+            if before_write is not None:
+                before_write()
+            self.pending = v + 1
+
+
+class TestGL114LostUpdate:
+    def test_unguarded_read_modify_write_loses_an_update(self):
+        """Two increments run; one visibly vanishes — the hazard GL114
+        flags statically.  The worker's whole update lands inside the
+        public caller's read->write window, then the stale write
+        clobbers it."""
+        b = UnguardedBatcher()
+
+        def interleave():
+            t = threading.Thread(target=b.increment)
+            t.start()
+            t.join()        # worker's increment fully applied... for now
+
+        b.increment(before_write=interleave)
+        assert b.pending == 1           # two increments, one survivor
+
+    def test_common_lock_preserves_both_updates(self):
+        """Same choreography against the guarded class: the worker's
+        increment blocks on the lock until the public caller's window
+        closes, so both updates land."""
+        b = GuardedBatcher()
+        worker = threading.Thread(target=b.increment)
+
+        def spawn_racer():
+            worker.start()
+            # the worker cannot finish while we hold the lock: its whole
+            # increment is parked outside our read->write window
+            assert worker.is_alive()
+
+        b.increment(before_write=spawn_racer)
+        worker.join()
+        assert b.pending == 2
+
+
+# ---------------------------------------------------------------- GL115
+class SplitWriter:
+    """File proxy that splits every write in half around a barrier —
+    forcing the two-writer byte interleaving the OS is free to produce
+    whenever two threads share one stream without a lock."""
+
+    def __init__(self, f, barrier):
+        self._f = f
+        self._barrier = barrier
+
+    def write(self, s):
+        mid = len(s) // 2
+        self._f.write(s[:mid])
+        if self._barrier is not None:
+            self._barrier.wait(timeout=10)
+        self._f.write(s[mid:])
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+    @property
+    def closed(self):
+        return self._f.closed
+
+
+class TestGL115SinkInterleaving:
+    def test_unguarded_concurrent_emit_corrupts_the_stream(self, tmp_path):
+        """Two threads emit through one RunLog with no lock; the forced
+        mid-line handoff interleaves the JSONL bytes and the strict
+        reader rejects the file — the hazard GL115 flags statically."""
+        path = str(tmp_path / "events.jsonl")
+        log = RunLog(path)
+        log._f = SplitWriter(log._f, threading.Barrier(2))
+
+        t = threading.Thread(target=log.emit, args=("checkpoint",),
+                             kwargs={"epoch": 2})
+        t.start()
+        log.emit("checkpoint", epoch=1)
+        t.join()
+        log.close()
+
+        with pytest.raises(ValueError):
+            list(read_events(path))
+
+    def test_lock_serialized_emit_survives_the_same_pressure(self,
+                                                             tmp_path):
+        """The fix the rule message prescribes: one lock around emit.
+        The same split-writer perturbation cannot interleave bytes
+        because the lock keeps whole emits exclusive."""
+        path = str(tmp_path / "events.jsonl")
+        log = RunLog(path)
+        log._f = SplitWriter(log._f, barrier=None)
+        lock = threading.Lock()
+        n_each = 5
+
+        def emit_many(base):
+            for i in range(n_each):
+                with lock:
+                    log.emit("checkpoint", epoch=base + i)
+
+        t = threading.Thread(target=emit_many, args=(100,))
+        t.start()
+        emit_many(0)
+        t.join()
+        log.close()
+
+        events = list(read_events(path))
+        assert len(events) == 2 * n_each
+        assert {e["epoch"] for e in events} == (
+            set(range(n_each)) | set(range(100, 100 + n_each)))

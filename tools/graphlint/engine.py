@@ -122,16 +122,23 @@ class Context:
 # than to whichever rule happens to touch it first)
 PROJECT_PASS = "project-resolution"
 
+# rule_seconds key for the wave-4 value-flow prepass (tools/graphlint/
+# flow.py: per-file scopes, binding chains, class concurrency models) —
+# built before the project pass, which consumes it
+FLOW_PASS = "value-flow"
+
 
 @dataclasses.dataclass
 class RunStats:
     """Wall-time + resolution accounting for one lint run (report schema
-    v3): per-rule seconds so a slow rule cannot silently blow up lint
-    time, and the cross-module pass's files/symbols-resolved counts."""
+    v4): per-rule seconds so a slow rule cannot silently blow up lint
+    time, the cross-module pass's files/symbols-resolved counts, and the
+    value-flow layer's resolution counters."""
 
     rule_seconds: Dict[str, float]
     total_seconds: float
     resolution: Dict[str, int]
+    flow: Dict[str, int]
 
     def slowest(self, n: int = 3) -> List[Tuple[str, float]]:
         return sorted(self.rule_seconds.items(),
@@ -213,13 +220,19 @@ def run(paths: Sequence[str], rules: Sequence[Rule],
     parsed = [f for f in files if f.tree is not None]
 
     ctx = Context(parsed)
-    # whole-program layer up front: one timed pass shared by every rule
+    # shared prepasses up front, each timed under its own key: the
+    # value-flow layer first (the project pass consumes it), then the
+    # whole-program resolution pass
+    from tools.graphlint import flow as flow_mod
     from tools.graphlint import project
+    t0 = time.perf_counter()
+    flow_mod.for_context(ctx)
+    rule_seconds: Dict[str, float] = {
+        FLOW_PASS: time.perf_counter() - t0}
     t0 = time.perf_counter()
     project.get_index(ctx)
     project.project_traced(ctx)
-    rule_seconds: Dict[str, float] = {
-        PROJECT_PASS: time.perf_counter() - t0}
+    rule_seconds[PROJECT_PASS] = time.perf_counter() - t0
 
     for rule in rules:
         t0 = time.perf_counter()
@@ -251,5 +264,6 @@ def run(paths: Sequence[str], rules: Sequence[Rule],
     findings = sorted(set(findings), key=Finding.key)
     stats = RunStats(rule_seconds=rule_seconds,
                      total_seconds=time.perf_counter() - t_run,
-                     resolution=project.resolution_stats(ctx))
+                     resolution=project.resolution_stats(ctx),
+                     flow=flow_mod.flow_stats(ctx))
     return findings, files, stats

@@ -1,0 +1,497 @@
+"""Value-flow / def-use layer shared by the wave-4 rules (pure AST).
+
+graphlint wave 4 (ISSUE 19).  Waves 1-3 resolve *names*: imports,
+re-exports, and functions staged for tracing by literal position.  Real
+code moves values around before using them — ``kernel =
+functools.partial(kernel, n=4)`` rebinds, ``self._jitted =
+plan.jit_serve_step(fn)`` stashes a jitted callable on an instance,
+donated buffers ride through tuple/dict literals — and every one of
+those hops made a wave-3 rule stand down.  This module is the shared
+def-use layer that follows the hops, still without ever importing the
+code under analysis:
+
+- **partial chains** (:meth:`FileFlow.resolve_callable`): ``name =
+  functools.partial(fn, ...)`` bindings followed transitively, including
+  the rebound ``kernel = partial(kernel, ...)`` spelling, with plain
+  ``alias = fn`` hops in between, bounded by :data:`MAX_PARTIAL_HOPS`.
+  Resolution is scope-aware (latest binding in the use's enclosing
+  function, falling back to module scope) so a name reused across two
+  functions never cross-contaminates.
+- **class-attribute bindings** (:class:`ClassModel`): ``self.<attr> =
+  <value>`` assignments indexed per class; an attribute resolves ONLY
+  when it is bound exactly once across the whole class (the
+  assigned-once gate — anything rebound or conditionally bound stands
+  down, preserving the zero-false-positive contract).
+- **tracing forwarders** (:meth:`FileFlow.forwarders`): defs whose
+  parameter is itself staged for tracing inside the body — the compile
+  plan's ``jit_<entry>(fn)`` builders.  A call to a forwarder marks the
+  caller's argument as traced even though the call itself is not a
+  ``TRACING_CALL``.
+- **host-concurrency model** (:class:`ClassModel`): per-class thread
+  entry points (``threading.Thread(target=self.<method>)``), lock
+  attributes, the intra-class ``self.<m>()`` call graph, and per-site
+  ``with self.<lock>:`` held-lock sets — :meth:`ClassModel.reach`
+  computes, for each entry method, which methods run on that entry's
+  thread and which locks are held on EVERY discovered path (path merge
+  is set intersection, so a lock counts only when it is always held).
+  rules/thread_shared.py (GL114/GL115) consumes this.
+
+House rule unchanged: anything that does not resolve statically —
+unresolvable receivers, ``**kwargs`` plumbing, attributes bound more
+than once, thread targets that are not ``self.<method>`` — stands down.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from tools.graphlint.astutil import (FuncNode, TRACING_CALLS,
+                                     _function_args_of_call, qualname)
+
+# a partial/alias chain longer than this stands down (cycles are cut by
+# the before-line recursion; the hop bound guards pathological rebinds)
+MAX_PARTIAL_HOPS = 8
+
+# lock-ish threading types whose instance attributes count as guards
+_LOCK_TYPES = {"threading.Lock", "threading.RLock", "threading.Condition"}
+
+# sink types whose single-writer contract GL115 enforces: attr -> label
+_SINK_RUNLOG = "RunLog"
+_SINK_FILE = "open()-file"
+_SINK_METHODS = {"emit", "write", "writelines"}
+
+
+class ForwardSpec:
+    """Which parameters of a def are staged for tracing by its body."""
+
+    def __init__(self, func: ast.AST, is_method: bool,
+                 positions: Set[int], names: Set[str]) -> None:
+        self.func = func
+        self.is_method = is_method
+        self.positions = positions    # indices into the full param list
+        self.names = names
+
+
+class ClassModel:
+    """Concurrency + attribute-binding model of one ``class`` body."""
+
+    def __init__(self, node: ast.ClassDef, f) -> None:
+        self.node = node
+        self.name = node.name
+        self.f = f
+        self.imports = f.imports
+        # unique method name -> def (duplicate names stand down entirely)
+        self.methods: Dict[str, ast.AST] = {}
+        dup: Set[str] = set()
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if item.name in self.methods:
+                    dup.add(item.name)
+                else:
+                    self.methods[item.name] = item
+        for d in dup:
+            del self.methods[d]
+        # self.<attr> = <value> plain assigns: attr -> [(Assign, method)]
+        self.attr_assigns: Dict[str, List[Tuple[ast.Assign, str]]] = {}
+        self.lock_attrs: Set[str] = set()
+        self.sink_attrs: Dict[str, str] = {}     # attr -> sink label
+        # (method name, spawn line) per threading.Thread(target=self.<m>)
+        self.thread_targets: List[Tuple[str, int]] = []
+        # guarded events, collected per method with held-lock context:
+        # attr -> [(method, line, with-locks)] for self.<attr> stores
+        self.attr_stores: Dict[str, List[Tuple[str, int,
+                                               FrozenSet[str]]]] = {}
+        # sink attr -> [(method, line, with-locks)] for .emit/.write calls
+        self.sink_uses: Dict[str, List[Tuple[str, int,
+                                             FrozenSet[str]]]] = {}
+        # method -> [(callee method, with-locks at the call)]
+        self.calls: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        self._index_attrs()
+        for mname, meth in self.methods.items():
+            self._walk_stmts(mname, meth.body, frozenset())
+
+    # ------------------------------------------------------------ bindings
+    def _index_attrs(self) -> None:
+        for mname, meth in self.methods.items():
+            for sub in ast.walk(meth):
+                if isinstance(sub, FuncNode) and sub is not meth:
+                    continue
+                if not (isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1
+                        and self._self_attr(sub.targets[0])):
+                    continue
+                attr = sub.targets[0].attr
+                self.attr_assigns.setdefault(attr, []).append((sub, mname))
+                if isinstance(sub.value, ast.Call):
+                    q = qualname(sub.value.func, self.imports)
+                    if q in _LOCK_TYPES:
+                        self.lock_attrs.add(attr)
+                    elif q == "open":
+                        self.sink_attrs[attr] = _SINK_FILE
+                    elif q and q.split(".")[-1] == _SINK_RUNLOG:
+                        self.sink_attrs[attr] = _SINK_RUNLOG
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    def binding(self, attr: str) -> Optional[ast.Assign]:
+        """The unique ``self.<attr> = <value>`` assign — ``None`` (stand
+        down) when the attribute is bound zero times or more than once."""
+        assigns = self.attr_assigns.get(attr, [])
+        return assigns[0][0] if len(assigns) == 1 else None
+
+    # ------------------------------------------------- guarded event walk
+    def _walk_stmts(self, mname: str, stmts, locks: FrozenSet[str]
+                    ) -> None:
+        for st in stmts:
+            if isinstance(st, FuncNode):
+                continue        # nested defs: their own (unmodeled) scope
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                acquired: Set[str] = set()
+                for item in st.items:
+                    ce = item.context_expr
+                    self._scan_expr(mname, ce, locks)
+                    if (self._self_attr(ce)
+                            and ce.attr in self.lock_attrs):
+                        acquired.add(ce.attr)
+                self._walk_stmts(mname, st.body, locks | acquired)
+                continue
+            # stores on self.<attr>
+            if isinstance(st, ast.Assign):
+                for t in st.targets:
+                    self._record_store(mname, t, locks)
+            elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+                self._record_store(mname, st.target, locks)
+            # expression parts of this statement (nested blocks recurse)
+            for child in ast.iter_child_nodes(st):
+                if not isinstance(child, (ast.stmt, ast.excepthandler)):
+                    self._scan_expr(mname, child, locks)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if isinstance(sub, list):
+                    self._walk_stmts(mname, sub, locks)
+            for h in getattr(st, "handlers", []):
+                self._walk_stmts(mname, h.body, locks)
+
+    def _record_store(self, mname: str, target: ast.AST,
+                      locks: FrozenSet[str]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._record_store(mname, e, locks)
+            return
+        if self._self_attr(target):
+            self.attr_stores.setdefault(target.attr, []).append(
+                (mname, target.lineno, locks))
+
+    def _scan_expr(self, mname: str, expr: ast.AST,
+                   locks: FrozenSet[str]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, FuncNode) or not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            # intra-class call graph: self.<m>(...)
+            if self._self_attr(fn) and fn.attr in self.methods:
+                self.calls.setdefault(mname, []).append((fn.attr, locks))
+            # sink writes: self.<attr>.emit(...) / .write(...)
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in _SINK_METHODS
+                    and self._self_attr(fn.value)
+                    and fn.value.attr in self.sink_attrs):
+                self.sink_uses.setdefault(fn.value.attr, []).append(
+                    (mname, node.lineno, locks))
+            # thread spawns: threading.Thread(target=self.<m>)
+            if qualname(fn, self.imports) == "threading.Thread":
+                for kw in node.keywords:
+                    if (kw.arg == "target" and self._self_attr(kw.value)
+                            and kw.value.attr in self.methods):
+                        self.thread_targets.append(
+                            (kw.value.attr, node.lineno))
+                # positional / **kwargs / non-self targets: stand down
+
+    # --------------------------------------------------------- reachability
+    def reach(self, entry: str) -> Dict[str, FrozenSet[str]]:
+        """method -> locks held on EVERY discovered path from ``entry``
+        (path merge = intersection: a lock counts only if always held)."""
+        held: Dict[str, FrozenSet[str]] = {entry: frozenset()}
+        work = [entry]
+        while work:
+            m = work.pop()
+            base = held[m]
+            for callee, locks in self.calls.get(m, ()):  # noqa: B020
+                h = base | locks
+                if callee in held:
+                    merged = held[callee] & h
+                    if merged != held[callee]:
+                        held[callee] = merged
+                        work.append(callee)
+                else:
+                    held[callee] = h
+                    work.append(callee)
+        return held
+
+    def worker_entries(self) -> List[str]:
+        return sorted({m for m, _ in self.thread_targets})
+
+    def public_entries(self) -> List[str]:
+        workers = set(self.worker_entries())
+        return sorted(m for m in self.methods
+                      if not m.startswith("_") and m not in workers)
+
+    def spawn_line(self, method: str) -> int:
+        return min(line for m, line in self.thread_targets if m == method)
+
+
+class FileFlow:
+    """Per-file value-flow index: scopes, name bindings, class models,
+    tracing forwarders.  Built once per file per lint run (cached on the
+    engine Context) and shared by every wave-4 consumer."""
+
+    def __init__(self, f) -> None:
+        self.f = f
+        self.imports = f.imports
+        # node -> innermost enclosing function (None = module scope)
+        self._scope_of: Dict[int, Optional[ast.AST]] = {}
+        # (scope id, name) -> [(lineno, value expr)] for single-Name assigns
+        self._bindings: Dict[Tuple[int, str],
+                             List[Tuple[int, ast.AST]]] = {}
+        self._build_scopes(f.tree)
+        self.classes: List[ClassModel] = [
+            ClassModel(c, f) for c in ast.walk(f.tree)
+            if isinstance(c, ast.ClassDef)]
+        self._class_of_method: Dict[int, ClassModel] = {}
+        for cm in self.classes:
+            for meth in cm.methods.values():
+                self._class_of_method[id(meth)] = cm
+        self._forwarders: Optional[Dict[ast.AST, ForwardSpec]] = None
+
+    # ------------------------------------------------------------- scopes
+    def _build_scopes(self, tree: ast.Module) -> None:
+        def visit(node: ast.AST, scope: Optional[ast.AST]) -> None:
+            for child in ast.iter_child_nodes(node):
+                self._scope_of[id(child)] = scope
+                visit(child,
+                      child if isinstance(child, FuncNode) else scope)
+
+        visit(tree, None)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                scope = self._scope_of.get(id(node))
+                key = (id(scope) if scope is not None else 0,
+                       node.targets[0].id)
+                self._bindings.setdefault(key, []).append(
+                    (node.lineno, node.value))
+        for entries in self._bindings.values():
+            entries.sort(key=lambda kv: kv[0])
+
+    def enclosing_scope(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._scope_of.get(id(node))
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ClassModel]:
+        s = self._scope_of.get(id(node))
+        while s is not None:
+            cm = self._class_of_method.get(id(s))
+            if cm is not None:
+                return cm
+            s = self._scope_of.get(id(s))
+        return None
+
+    def _binding_before(self, scope: Optional[ast.AST], name: str,
+                        line: int) -> Optional[Tuple[int, ast.AST]]:
+        """Latest single-Name binding of ``name`` strictly before
+        ``line``, in ``scope`` first, then module scope (closure read)."""
+        scopes = [scope, None] if scope is not None else [None]
+        for s in scopes:
+            key = (id(s) if s is not None else 0, name)
+            best: Optional[Tuple[int, ast.AST]] = None
+            for lineno, value in self._bindings.get(key, ()):
+                if lineno < line:
+                    best = (lineno, value)
+            if best is not None:
+                return best
+        return None
+
+    # ------------------------------------------------------ partial chains
+    def _is_partial(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and qualname(node.func, self.imports)
+                == "functools.partial" and bool(node.args))
+
+    def resolve_callable(self, node: ast.AST, use_node: ast.AST
+                         ) -> Tuple[ast.AST, int]:
+        """Follow partial/alias chains (and the assigned-once
+        ``self.<attr>`` hop) from a callable expression to its base
+        expression.  Returns ``(base expr, hops)``; ``hops == 0`` means
+        no chain applied and the original node is returned.  The base is
+        whatever the chain bottoms out at — typically a Name or
+        Attribute the caller then resolves through the project index."""
+        scope = self.enclosing_scope(use_node)
+        line = getattr(use_node, "lineno", 1 << 30)
+        hops = 0
+        while hops < MAX_PARTIAL_HOPS:
+            if self._is_partial(node):
+                node = node.args[0]
+                hops += 1
+                continue
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                cm = self.enclosing_class(use_node)
+                assign = cm.binding(node.attr) if cm is not None else None
+                if assign is None:
+                    break
+                scope = self.enclosing_scope(assign)
+                line = assign.lineno
+                node = assign.value
+                hops += 1
+                continue
+            if isinstance(node, ast.Name):
+                hit = self._binding_before(scope, node.id, line)
+                if hit is None:
+                    break
+                bline, value = hit
+                if self._is_partial(value):
+                    node, line = value.args[0], bline
+                    hops += 1
+                    continue
+                if isinstance(value, ast.Name) and value.id != node.id:
+                    node, line = value, bline
+                    hops += 1
+                    continue
+                break
+            break
+        return node, hops
+
+    def partial_name_map(self) -> Dict[str, str]:
+        """name -> base function name for every ``name =
+        functools.partial(...)`` binding that bottoms out at a Name,
+        chains followed.  A name whose bindings disagree across scopes
+        stands down (dropped)."""
+        out: Dict[str, str] = {}
+        dropped: Set[str] = set()
+        for (sid, name), entries in self._bindings.items():
+            for lineno, value in entries:
+                if not self._is_partial(value):
+                    continue
+                base = value.args[0]
+                hops = 1
+                scope_hint = value
+                while hops < MAX_PARTIAL_HOPS:
+                    if self._is_partial(base):
+                        base = base.args[0]
+                        hops += 1
+                        continue
+                    if isinstance(base, ast.Name):
+                        hit = self._binding_before(
+                            self.enclosing_scope(scope_hint), base.id,
+                            lineno)
+                        if hit is not None and (
+                                self._is_partial(hit[1])
+                                or isinstance(hit[1], ast.Name)):
+                            lineno, base = hit[0], hit[1]
+                            if self._is_partial(base):
+                                base = base.args[0]
+                            hops += 1
+                            continue
+                    break
+                if isinstance(base, ast.Name) and base.id != name:
+                    if name in out and out[name] != base.id:
+                        dropped.add(name)
+                    out[name] = base.id
+        for name in dropped:
+            out.pop(name, None)
+        return out
+
+    # --------------------------------------------------------- forwarders
+    def forwarders(self) -> Dict[ast.AST, ForwardSpec]:
+        """defs whose parameter ends up staged for tracing inside the
+        body — directly (``jax.jit(fn, ...)`` with ``fn`` a param, the
+        compile plan's ``jit_<entry>`` builders) or by being *called*
+        inside a nested def that the body stages."""
+        if self._forwarders is not None:
+            return self._forwarders
+        from tools.graphlint.astutil import traced_functions
+        traced = traced_functions(self.f.tree, self.imports)
+        method_ids = set(self._class_of_method)
+        out: Dict[ast.AST, ForwardSpec] = {}
+        for func in ast.walk(self.f.tree):
+            if not isinstance(func, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            params = [a.arg for a in (func.args.posonlyargs
+                                      + func.args.args)]
+            pset = set(params)
+            if not pset:
+                continue
+            # a param rebound in the body stands down
+            for sub in ast.walk(func):
+                if (isinstance(sub, ast.Name) and sub.id in pset
+                        and isinstance(sub.ctx, ast.Store)):
+                    pset.discard(sub.id)
+            if not pset:
+                continue
+            fwd: Set[str] = set()
+            for sub in ast.walk(func):
+                if not isinstance(sub, ast.Call):
+                    continue
+                q = qualname(sub.func, self.imports)
+                if q in TRACING_CALLS:
+                    for arg in _function_args_of_call(sub, self.imports):
+                        if isinstance(arg, ast.Name) and arg.id in pset:
+                            fwd.add(arg.id)
+                elif (isinstance(sub.func, ast.Name)
+                      and sub.func.id in pset):
+                    # param CALLED here: forwarded iff the call runs
+                    # under a trace staged by this body (nested traced
+                    # def, or the builder def itself being traced)
+                    enc = self.enclosing_scope(sub)
+                    while enc is not None and enc is not func:
+                        if enc in traced:
+                            fwd.add(sub.func.id)
+                            break
+                        enc = self.enclosing_scope(enc)
+            if fwd:
+                out[func] = ForwardSpec(
+                    func, is_method=id(func) in method_ids,
+                    positions={params.index(p) for p in fwd},
+                    names=fwd)
+        self._forwarders = out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Context-level cache + counters (engine times this as the value-flow pass)
+
+_COUNTER_KEYS = ("partial_chains_resolved", "attribute_bindings_resolved",
+                 "forwarded_traced", "thread_classes_analyzed")
+
+
+def for_context(ctx) -> Dict[object, FileFlow]:
+    """file -> FileFlow, built once per lint run."""
+    cached = ctx.store.get("flow_files")
+    if cached is None:
+        cached = {f: FileFlow(f) for f in ctx.files}
+        ctx.store["flow_files"] = cached
+        ctx.store.setdefault("flow_counters",
+                             {k: 0 for k in _COUNTER_KEYS})
+    return cached
+
+
+def flow_of(ctx, f) -> FileFlow:
+    return for_context(ctx)[f]
+
+
+def bump(ctx, key: str, n: int = 1) -> None:
+    counters = ctx.store.setdefault("flow_counters",
+                                    {k: 0 for k in _COUNTER_KEYS})
+    counters[key] = counters.get(key, 0) + n
+
+
+def flow_stats(ctx) -> Dict[str, int]:
+    """The JSON report's ``flow`` section: what the value-flow layer
+    resolved this run (all zero when nothing touched it)."""
+    counters = ctx.store.get("flow_counters", {})
+    return {k: int(counters.get(k, 0)) for k in _COUNTER_KEYS}

@@ -34,8 +34,8 @@ import dataclasses
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from tools.graphlint.astutil import (FuncNode, _function_args_of_call,
-                                     TRACING_CALLS, qualname,
-                                     traced_functions)
+                                     TRACING_CALLS, last_segment,
+                                     qualname, traced_functions)
 
 # Cross-module propagation guard: a traced call chain deeper than this
 # many module hops stops propagating (cycles are cut by the visited set;
@@ -234,23 +234,81 @@ def project_traced(ctx) -> Dict[object, Dict[ast.AST, Optional[TraceSite]]]:
     for f in ctx.files:
         scope[f] = {fn: None for fn in traced_functions(f.tree, f.imports)}
 
-    # seed: tracing calls staging a function that resolves cross-module
+    # seed: tracing calls staging a function that resolves cross-module,
+    # or (wave 4) through a value-flow hop — a partial chain or an
+    # assigned-once ``self.<attr>`` binding — or through a call to a
+    # tracing FORWARDER (a def like the compile plan's ``jit_<entry>``
+    # builders whose parameter is itself staged for tracing inside the
+    # body; the caller's argument is traced even though the call is not
+    # a TRACING_CALL)
+    from tools.graphlint import flow as flow_mod
+    flows = flow_mod.for_context(ctx)
+    fwd_specs, fwd_unique = _forwarder_index(ctx, flows)
+    # cheap pre-gate: only calls whose terminal name belongs to SOME
+    # forwarder def are worth resolving (keeps resolution stats honest)
+    fwd_names = {func.name for specs in fwd_specs.values()
+                 for func in specs}
     work: List[Tuple[object, ast.AST, TraceSite, int]] = []
     for f in ctx.files:
+        ff = flows[f]
         for node in ast.walk(f.tree):
             if not isinstance(node, ast.Call):
                 continue
             via = qualname(node.func, f.imports)
-            if via not in TRACING_CALLS:
+            if via in TRACING_CALLS:
+                for arg in _function_args_of_call(node, f.imports):
+                    if not isinstance(arg, (ast.Name, ast.Attribute)):
+                        continue
+                    base, hops = ff.resolve_callable(arg, node)
+                    if hops:
+                        # value-flow hop: the local layer cannot see
+                        # through it, so same-file defs are seeded too
+                        flow_mod.bump(
+                            ctx, "attribute_bindings_resolved"
+                            if (isinstance(arg, ast.Attribute)
+                                and isinstance(arg.value, ast.Name)
+                                and arg.value.id == "self")
+                            else "partial_chains_resolved")
+                        site = TraceSite(f.rel, node.lineno, via)
+                        if isinstance(base, ast.Lambda):
+                            work.append((f, base, site, 0))
+                            continue
+                        if not isinstance(base, (ast.Name,
+                                                 ast.Attribute)):
+                            continue
+                        hit = index.resolve_call_target(f, base)
+                        if hit is not None:
+                            work.append((hit[0], hit[1], site, 0))
+                        continue
+                    hit = index.resolve_call_target(f, arg)
+                    if hit is None or hit[0] is f:
+                        continue  # local (already covered) / unresolvable
+                    work.append((hit[0], hit[1],
+                                 TraceSite(f.rel, node.lineno, via), 0))
                 continue
-            for arg in _function_args_of_call(node, f.imports):
-                if not isinstance(arg, (ast.Name, ast.Attribute)):
+            # forwarder call: resolve the callee def, then seed its
+            # staged function arguments
+            if last_segment(node.func) not in fwd_names:
+                continue
+            spec = _forwarder_for_call(f, ff, node, index,
+                                       fwd_specs, fwd_unique)
+            if spec is None:
+                continue
+            tf, fspec = spec
+            offset = 1 if fspec.is_method else 0
+            site = TraceSite(f.rel, node.lineno, fspec.func.name)
+            for arg in _forwarded_args(node, fspec, offset):
+                base, _hops = ff.resolve_callable(arg, node)
+                if isinstance(base, ast.Lambda):
+                    work.append((f, base, site, 0))
+                    flow_mod.bump(ctx, "forwarded_traced")
                     continue
-                hit = index.resolve_call_target(f, arg)
-                if hit is None or hit[0] is f:
-                    continue    # local (already covered) or unresolvable
-                site = TraceSite(f.rel, node.lineno, via)
-                work.append((hit[0], hit[1], site, 0))
+                if not isinstance(base, (ast.Name, ast.Attribute)):
+                    continue
+                hit = index.resolve_call_target(f, base)
+                if hit is not None:
+                    work.append((hit[0], hit[1], site, 0))
+                    flow_mod.bump(ctx, "forwarded_traced")
 
     visited: Set[Tuple[int, int]] = set()
     cross_module = 0
@@ -308,6 +366,81 @@ def _defs_named(f, name: str) -> Iterable[ast.AST]:
         if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
                 and node.name == name):
             yield node
+
+
+# ---------------------------------------------------------------------------
+# wave-4 forwarder resolution (value-flow seeds for project_traced)
+
+def _forwarder_index(ctx, flows):
+    """Per-run forwarder tables: ``(fwd_specs, fwd_unique)``.
+
+    ``fwd_specs``: file -> {def node -> ForwardSpec}.  ``fwd_unique``:
+    def name -> (file, spec), only for names carried by EXACTLY ONE def
+    across the whole project — the uniqueness gate behind the
+    unresolvable-receiver fallback (``plan.jit_serve_step(...)`` where
+    ``plan`` is a runtime object: the method name must be globally
+    unambiguous or the call stands down)."""
+    cached = ctx.store.get("flow_forwarders")
+    if cached is not None:
+        return cached
+    def_name_counts: Dict[str, int] = {}
+    for f in ctx.files:
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                def_name_counts[node.name] = (
+                    def_name_counts.get(node.name, 0) + 1)
+    fwd_specs: Dict[object, Dict[ast.AST, object]] = {}
+    by_name: Dict[str, List[Tuple[object, object]]] = {}
+    for f, ff in flows.items():
+        specs = ff.forwarders()
+        fwd_specs[f] = specs
+        for func, spec in specs.items():
+            by_name.setdefault(func.name, []).append((f, spec))
+    fwd_unique = {name: entries[0] for name, entries in by_name.items()
+                  if len(entries) == 1
+                  and def_name_counts.get(name, 0) == 1}
+    ctx.store["flow_forwarders"] = (fwd_specs, fwd_unique)
+    return fwd_specs, fwd_unique
+
+
+def _forwarder_for_call(f, ff, node: ast.Call, index: ProjectIndex,
+                        fwd_specs, fwd_unique):
+    """The (file, ForwardSpec) a call resolves to, or ``None``."""
+    fn = node.func
+    # bare name / dotted module reference through the project index
+    hit = index.resolve_call_target(f, fn)
+    if hit is not None:
+        spec = fwd_specs.get(hit[0], {}).get(hit[1])
+        return (hit[0], spec) if spec is not None else None
+    # self.<m>(...): the enclosing class's own method
+    if (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+            and fn.value.id == "self"):
+        cm = ff.enclosing_class(node)
+        meth = cm.methods.get(fn.attr) if cm is not None else None
+        if meth is not None:
+            spec = fwd_specs.get(f, {}).get(meth)
+            return (f, spec) if spec is not None else None
+        return None
+    # <unresolvable receiver>.m(...): the project-wide unique def named m
+    if isinstance(fn, ast.Attribute):
+        return fwd_unique.get(fn.attr)
+    return None
+
+
+def _forwarded_args(call: ast.Call, spec, offset: int):
+    """Call arguments landing in the forwarder's staged positions —
+    positional mapping stops at the first ``*args`` splat, keywords
+    match by name, ``**kwargs`` stands down."""
+    out: List[ast.AST] = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i + offset in spec.positions:
+            out.append(arg)
+    for kw in call.keywords:
+        if kw.arg in spec.names:
+            out.append(kw.value)
+    return out
 
 
 def resolution_stats(ctx) -> Dict[str, int]:

@@ -17,7 +17,12 @@ from tools.graphlint.engine import Finding, LintedFile, RunStats
 # "project-resolution" pass) and resolution (what the cross-module layer
 # indexed/resolved), so a slow rule or a resolution regression is visible
 # in the committed evidence, not just in CI wall time
-SCHEMA_VERSION = 3
+# v4: + flow (wave-4 value-flow layer counters: partial chains /
+# attribute bindings / forwarder args resolved, thread classes
+# analyzed) and the "value-flow" prepass key in timing, so a flow-layer
+# regression — the linter silently standing down where it used to
+# resolve — shows up as a diff in the committed evidence
+SCHEMA_VERSION = 4
 
 
 def text_report(findings: Sequence[Finding],
@@ -38,6 +43,12 @@ def text_report(findings: Sequence[Finding],
             f"{res['symbols_resolved']} symbols resolved / "
             f"{res['symbols_unresolved']} stood down, "
             f"{res['cross_module_traced']} cross-module traced defs")
+        fl = stats.flow
+        lines.append(
+            f"graphlint: flow: {fl['partial_chains_resolved']} partial "
+            f"chains, {fl['attribute_bindings_resolved']} attr bindings, "
+            f"{fl['forwarded_traced']} forwarded traced, "
+            f"{fl['thread_classes_analyzed']} thread classes")
     return "\n".join(lines)
 
 
@@ -83,5 +94,6 @@ def json_report(findings: Sequence[Finding],
                 for rule, sec in sorted(stats.rule_seconds.items())},
         }
         payload["resolution"] = dict(stats.resolution)
+        payload["flow"] = dict(stats.flow)
     return json.dumps(payload, indent=2, sort_keys=True,
                       allow_nan=False) + "\n"

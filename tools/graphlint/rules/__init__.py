@@ -18,6 +18,8 @@ from tools.graphlint.rules.prng import PRNGReuseRule
 from tools.graphlint.rules.recompile import RecompileRule
 from tools.graphlint.rules.remat_tags import RematTagRule
 from tools.graphlint.rules.sharding_axes import ShardingAxesRule
+from tools.graphlint.rules.thread_shared import (ThreadSharedAttrRule,
+                                                 ThreadSharedSinkRule)
 
 
 def all_rules() -> List[Rule]:
@@ -25,4 +27,5 @@ def all_rules() -> List[Rule]:
             DonateRule(), RematTagRule(), CliDriftRule(),
             ShardingAxesRule(), CollectiveAxesRule(),
             PallasInterpretRule(), JsonNanRule(), PallasRngRule(),
-            CompilePlanContractRule(), DonationFlowRule()]
+            CompilePlanContractRule(), DonationFlowRule(),
+            ThreadSharedAttrRule(), ThreadSharedSinkRule()]

@@ -7,15 +7,27 @@ buffers).  tests/test_train_step.py's ``fresh()`` helper exists because the
 train step donates its state — this rule catches the pattern statically.
 
 Scope: module-local donors.  A name assigned ``jax.jit(fn,
-donate_argnums=...)`` is a donating callable; at each call site the names
-passed in donated positions become dead; a later load of a dead name
-(before rebinding) is a finding.  Loop bodies are walked twice so the
-canonical bug — donating the same state every iteration without
+donate_argnums=...)`` is a donating callable — as is (wave 4) a
+``self.<attr>`` bound to one exactly once across the file; at each call
+site the names passed in donated positions become dead; a later load of a
+dead name (before rebinding) is a finding.  Loop bodies are walked twice
+so the canonical bug — donating the same state every iteration without
 rebinding — is caught.  Donors bound through the COMPILE PLAN's builders
 (``plan.jit_train_step(...)``), including ones imported from another
 module, are GL113's job (rules/donation_flow.py) — it reuses this
 module's :class:`DonationWalker` so both rules agree on what "reuse"
 means.
+
+Wave 4 value flow: the walker also tracks donated buffers riding in
+tuple/list/dict LITERALS and through tuple unpacking.  A container
+literal of plain names is remembered member-by-member; when a member
+name's buffer dies, the container slot dies with it (a later rebind of
+the name does not resurrect the slot — the container still holds the old
+buffer).  Dead slots are reported on constant-key subscript loads
+(``bundle[0]``, ``d["state"]``), ``fn(*bundle)`` splats, and propagate
+through tuple-unpack / subscript ALIASING (``s, _ = bundle`` marks ``s``
+dead).  Anything else — non-literal containers, computed keys, a bare
+container name passed whole — stands down.
 """
 from __future__ import annotations
 
@@ -37,10 +49,125 @@ class DonSpec:
         self.names = names
 
 
+def self_attr_assign_counts(f: LintedFile) -> Dict[str, int]:
+    """How many times each ``self.<attr>`` is assigned anywhere in the
+    file — the uniqueness gate for attribute donors (an attr bound in
+    two classes/methods would make the flat walker cross-attribute
+    call sites, so anything bound more than once stands down)."""
+    counts: Dict[str, int] = {}
+    for node in ast.walk(f.tree):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target]
+                   if isinstance(node, (ast.AugAssign, ast.AnnAssign))
+                   else [])
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                counts[t.attr] = counts.get(t.attr, 0) + 1
+    return counts
+
+
+def donor_key(func_expr: ast.AST) -> Optional[str]:
+    """The donor-table key a call target matches: a bare name, or
+    ``self.<attr>`` spelled as ``"self.<attr>"``.  Anything else (an
+    unresolvable receiver) returns ``None`` and stands down."""
+    if isinstance(func_expr, ast.Name):
+        return func_expr.id
+    if (isinstance(func_expr, ast.Attribute)
+            and isinstance(func_expr.value, ast.Name)
+            and func_expr.value.id == "self"):
+        return "self." + func_expr.attr
+    return None
+
+
+class _State:
+    """Per-block flow state: dead names, tracked container literals,
+    dead container slots."""
+
+    def __init__(self) -> None:
+        self.dead: Dict[str, int] = {}
+        # container name -> {key (int index | str) -> member name}
+        self.containers: Dict[str, Dict[object, str]] = {}
+        # (container name, key) -> donation line
+        self.dead_slots: Dict[Tuple[str, object], int] = {}
+
+    def copy(self) -> "_State":
+        st = _State()
+        st.dead = dict(self.dead)
+        st.containers = {k: dict(v) for k, v in self.containers.items()}
+        st.dead_slots = dict(self.dead_slots)
+        return st
+
+    def merge_either(self, a: "_State", b: "_State") -> None:
+        """dead in either branch -> dead; containers must agree in both
+        branches to stay tracked (disagreement stands down)."""
+        self.dead = {**b.dead, **a.dead}
+        self.containers = {k: v for k, v in a.containers.items()
+                           if b.containers.get(k) == v}
+        self.dead_slots = {**b.dead_slots, **a.dead_slots}
+
+    def kill(self, name: str, line: int) -> None:
+        """A name's buffer died: mark it dead and kill every container
+        slot currently holding it (the slot keeps the old buffer even if
+        the name is later rebound)."""
+        self.dead[name] = line
+        for cname, members in self.containers.items():
+            for ckey, member in members.items():
+                if member == name:
+                    self.dead_slots[(cname, ckey)] = line
+
+    def kill_slot(self, cname: str, ckey, line: int) -> None:
+        self.dead_slots[(cname, ckey)] = line
+        member = self.containers.get(cname, {}).get(ckey)
+        if member is not None:
+            self.dead[member] = line
+
+    def drop_name(self, name: str) -> None:
+        """A name was rebound: it is alive again, and containers that
+        recorded it no longer track the (old) buffer under that name."""
+        self.dead.pop(name, None)
+        for members in self.containers.values():
+            stale = [k for k, m in members.items() if m == name]
+            for k in stale:
+                del members[k]
+
+    def drop_container(self, name: str) -> None:
+        self.containers.pop(name, None)
+        stale = [k for k in self.dead_slots if k[0] == name]
+        for k in stale:
+            del self.dead_slots[k]
+
+
+def _const_key(node: ast.AST):
+    """A constant subscript key (int index / str key), else ``None``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                     (int, str)):
+        return node.value
+    return None
+
+
+def _literal_members(value: ast.AST) -> Optional[Dict[object, str]]:
+    """Member map of a tuple/list/dict literal whose elements are plain
+    names (non-Name members are simply not tracked)."""
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return {i: e.id for i, e in enumerate(value.elts)
+                if isinstance(e, ast.Name)}
+    if isinstance(value, ast.Dict):
+        out: Dict[object, str] = {}
+        for k, v in zip(value.keys, value.values):
+            ckey = _const_key(k) if k is not None else None
+            if ckey is not None and isinstance(v, ast.Name):
+                out[ckey] = v.id
+        return out
+    return None
+
+
 class DonationWalker:
     """Flow walk shared by GL104 (module-local donors) and GL113
     (plan-builder donors): tracks names whose buffers died at a donating
-    call and reports loads of a dead name before rebinding.
+    call — including buffers riding in container literals — and reports
+    loads of a dead name/slot before rebinding.
 
     ``on_use(node, name, donated_line)`` is called once per (name, line)
     of dead-name reuse; the owning rule turns it into a finding.
@@ -55,62 +182,162 @@ class DonationWalker:
     def walk_module(self, f: LintedFile) -> None:
         for func in ast.walk(f.tree):
             if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self._walk_block(func.body, {})
-        self._walk_block(f.tree.body, {})
+                self._walk_block(func.body, _State())
+        self._walk_block(f.tree.body, _State())
 
-    # dead: name -> line where it was donated
-    def _walk_block(self, stmts, dead: Dict[str, int]) -> None:
+    def _walk_block(self, stmts, st: _State) -> None:
         for stmt in stmts:
-            self._walk_stmt(stmt, dead)
+            self._walk_stmt(stmt, st)
 
-    def _walk_stmt(self, stmt, dead: Dict[str, int]) -> None:
+    def _walk_stmt(self, stmt, st: _State) -> None:
         if isinstance(stmt, FuncNode):
             return
         if isinstance(stmt, ast.If):
-            self._scan_expr(stmt.test, dead)
-            d1, d2 = dict(dead), dict(dead)
-            self._walk_block(stmt.body, d1)
-            self._walk_block(stmt.orelse, d2)
-            dead.clear()
-            dead.update({**d2, **d1})      # dead in either branch -> dead
+            self._scan_expr(stmt.test, st)
+            s1, s2 = st.copy(), st.copy()
+            self._walk_block(stmt.body, s1)
+            self._walk_block(stmt.orelse, s2)
+            st.merge_either(s1, s2)
             return
         if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
             if isinstance(stmt, (ast.For, ast.AsyncFor)):
-                self._scan_expr(stmt.iter, dead)
-                self._rebind_target(stmt.target, dead)
+                self._scan_expr(stmt.iter, st)
+                self._rebind_target(stmt.target, st)
             else:
-                self._scan_expr(stmt.test, dead)
+                self._scan_expr(stmt.test, st)
             for _ in range(2):     # second pass: donated last iteration
-                self._walk_block(stmt.body, dead)
-            self._walk_block(stmt.orelse, dead)
+                self._walk_block(stmt.body, st)
+            self._walk_block(stmt.orelse, st)
             return
         if isinstance(stmt, ast.Assign):
-            self._scan_expr(stmt.value, dead)
-            for t in stmt.targets:
-                self._rebind_target(t, dead)
+            self._assign(stmt, st)
             return
         if isinstance(stmt, ast.Try):
-            self._walk_block(stmt.body, dead)
+            self._walk_block(stmt.body, st)
             for h in stmt.handlers:
-                self._walk_block(h.body, dict(dead))
-            self._walk_block(stmt.orelse, dead)
-            self._walk_block(stmt.finalbody, dead)
+                self._walk_block(h.body, st.copy())
+            self._walk_block(stmt.orelse, st)
+            self._walk_block(stmt.finalbody, st)
             return
         if isinstance(stmt, ast.With):
             for item in stmt.items:
-                self._scan_expr(item.context_expr, dead)
-            self._walk_block(stmt.body, dead)
+                self._scan_expr(item.context_expr, st)
+            self._walk_block(stmt.body, st)
             return
-        self._scan_expr(stmt, dead)
+        self._scan_expr(stmt, st)
 
-    def _rebind_target(self, target, dead: Dict[str, int]) -> None:
+    # ----------------------------------------------------------- assigns
+    def _assign(self, stmt: ast.Assign, st: _State) -> None:
+        value = stmt.value
+        single = (stmt.targets[0]
+                  if len(stmt.targets) == 1 else None)
+
+        # pure ALIAS of a dead slot: `x = c[0]` — the buffer is not read
+        # here, so no finding; the target inherits the deadness instead
+        if (isinstance(single, ast.Name)
+                and isinstance(value, ast.Subscript)
+                and isinstance(value.value, ast.Name)):
+            ckey = _const_key(value.slice)
+            slot = (value.value.id, ckey)
+            if ckey is not None and slot in st.dead_slots:
+                line = st.dead_slots[slot]
+                self._rebind_target(single, st)
+                st.dead[single.id] = line
+                return
+
+        self._scan_expr(value, st)
+
+        # tuple-unpack of a tracked container: `a, b = c` — targets
+        # bound to dead slots become dead names (alias, not a read)
+        if (isinstance(single, (ast.Tuple, ast.List))
+                and isinstance(value, ast.Name)):
+            cname = value.id
+            self._rebind_target(single, st)
+            for i, elt in enumerate(single.elts):
+                if (isinstance(elt, ast.Name)
+                        and (cname, i) in st.dead_slots):
+                    st.dead[elt.id] = st.dead_slots[(cname, i)]
+            return
+
+        for t in stmt.targets:
+            self._rebind_target(t, st)
+
+        # container literal / container alias tracking
+        if isinstance(single, ast.Name):
+            members = _literal_members(value)
+            if members is not None:
+                st.containers[single.id] = members
+                # members already dead at literal-build time: the slot is
+                # born dead (the Name load above was flagged already)
+                for ckey, member in members.items():
+                    if member in st.dead:
+                        st.dead_slots[(single.id, ckey)] = st.dead[member]
+            elif (isinstance(value, ast.Name)
+                  and value.id in st.containers):
+                src_name = value.id
+                st.containers[single.id] = dict(st.containers[src_name])
+                for (cn, ckey), line in list(st.dead_slots.items()):
+                    if cn == src_name:
+                        st.dead_slots[(single.id, ckey)] = line
+
+    def _rebind_target(self, target, st: _State) -> None:
         if isinstance(target, ast.Name):
-            dead.pop(target.id, None)
+            st.drop_name(target.id)
+            st.drop_container(target.id)
         elif isinstance(target, (ast.Tuple, ast.List)):
             for e in target.elts:
-                self._rebind_target(e, dead)
+                self._rebind_target(e, st)
 
-    def _scan_expr(self, node, dead: Dict[str, int]) -> None:
+    # ------------------------------------------------------------- scans
+    def _donated_of_call(self, n: ast.Call, st: _State
+                         ) -> List[Tuple[object, int]]:
+        """What a donor call kills: entries are ``("name", line)`` for
+        plain names and ``(("slot", cname, key), line)`` for container
+        slots reached through splats/subscripts."""
+        dkey = donor_key(n.func)
+        spec = self.donors.get(dkey) if dkey is not None else None
+        if spec is None:
+            return []
+        killed: List[Tuple[object, int]] = []
+        pos = 0
+        for arg in n.args:
+            if isinstance(arg, ast.Starred):
+                inner = arg.value
+                members = (st.containers.get(inner.id)
+                           if isinstance(inner, ast.Name) else None)
+                if members is None:
+                    break     # unknown splat: positions unknowable
+                width = (max((k for k in members
+                              if isinstance(k, int)), default=-1) + 1)
+                for i in range(width):
+                    if pos + i in spec.nums:
+                        killed.append(
+                            (("slot", inner.id, i), n.lineno))
+                pos += width
+                continue
+            if pos in spec.nums:
+                if isinstance(arg, ast.Name):
+                    killed.append((arg.id, n.lineno))
+                elif (isinstance(arg, ast.Subscript)
+                      and isinstance(arg.value, ast.Name)):
+                    k = _const_key(arg.slice)
+                    if (k is not None
+                            and arg.value.id in st.containers):
+                        killed.append(
+                            (("slot", arg.value.id, k), n.lineno))
+            pos += 1
+        for kw in n.keywords:
+            if kw.arg in spec.names and isinstance(kw.value, ast.Name):
+                killed.append((kw.value.id, n.lineno))
+        return killed
+
+    def _emit(self, node: ast.AST, display: str, line: int) -> None:
+        mark = (display, getattr(node, "lineno", 0))
+        if mark not in self._emitted:
+            self._emitted.add(mark)
+            self.on_use(node, display, line)
+
+    def _scan_expr(self, node, st: _State) -> None:
         if node is None:
             return
         # source-order walk: loads checked before this statement's donations
@@ -118,30 +345,39 @@ class DonationWalker:
             (n for n in ast.walk(node) if not isinstance(n, FuncNode)),
             key=lambda n: (getattr(n, "lineno", 0),
                            getattr(n, "col_offset", 0)))
-        newly_donated: List[Tuple[str, int]] = []
+        newly_killed: List[Tuple[object, int]] = []
         for n in nodes:
-            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
-                    and n.func.id in self.donors):
-                spec = self.donors[n.func.id]
-                for i, arg in enumerate(n.args):
-                    if i in spec.nums and isinstance(arg, ast.Name):
-                        newly_donated.append((arg.id, n.lineno))
-                for kw in n.keywords:
-                    if kw.arg in spec.names and isinstance(kw.value,
-                                                           ast.Name):
-                        newly_donated.append((kw.value.id, n.lineno))
+            if isinstance(n, ast.Call):
+                newly_killed.extend(self._donated_of_call(n, st))
         # loads are checked BEFORE this statement's donations take effect,
         # so `state, m = step(state, b)` stays clean while re-donating or
         # re-reading an already-dead name is flagged.
         for n in nodes:
             if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
-                    and n.id in dead):
-                mark = (n.id, getattr(n, "lineno", 0))
-                if mark not in self._emitted:
-                    self._emitted.add(mark)
-                    self.on_use(n, n.id, dead[n.id])
-        for name, line in newly_donated:
-            dead[name] = line
+                    and n.id in st.dead):
+                self._emit(n, n.id, st.dead[n.id])
+            elif (isinstance(n, ast.Subscript)
+                  and isinstance(n.ctx, ast.Load)
+                  and isinstance(n.value, ast.Name)):
+                k = _const_key(n.slice)
+                if k is not None and (n.value.id, k) in st.dead_slots:
+                    self._emit(n, f"{n.value.id}[{k!r}]",
+                               st.dead_slots[(n.value.id, k)])
+            elif (isinstance(n, ast.Starred)
+                  and isinstance(n.value, ast.Name)):
+                cname = n.value.id
+                for (cn, k), line in sorted(
+                        st.dead_slots.items(),
+                        key=lambda kv: str(kv[0])):
+                    if cn == cname:
+                        self._emit(n, f"{cname}[{k!r}]", line)
+                        break
+        for what, line in newly_killed:
+            if isinstance(what, str):
+                st.kill(what, line)
+            else:
+                _, cname, key = what
+                st.kill_slot(cname, key, line)
 
 
 class DonateRule(Rule):
@@ -150,7 +386,7 @@ class DonateRule(Rule):
     doc = "reading a buffer after passing it in a donate_argnums position"
 
     def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
-        donors = self._donating_callables(f)
+        donors = self._donating_callables(f, ctx)
         if not donors:
             return []
         findings: List[Finding] = []
@@ -164,13 +400,26 @@ class DonateRule(Rule):
         DonationWalker(donors, on_use).walk_module(f)
         return findings
 
-    def _donating_callables(self, f: LintedFile) -> Dict[str, DonSpec]:
+    def _donating_callables(self, f: LintedFile,
+                            ctx: Context) -> Dict[str, DonSpec]:
         donors: Dict[str, DonSpec] = {}
+        attr_counts = self_attr_assign_counts(f)
         for node in ast.walk(f.tree):
             if not (isinstance(node, ast.Assign) and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
                     and isinstance(node.value, ast.Call)
                     and qualname(node.value.func, f.imports) in _JIT_CALLS):
+                continue
+            target = node.targets[0]
+            dkey: Optional[str] = None
+            if isinstance(target, ast.Name):
+                dkey = target.id
+            elif donor_key(target) is not None:
+                # self.<attr> donor: only when bound exactly once across
+                # the file (two classes reusing the attr name would make
+                # the walker cross-attribute them — stand down)
+                if attr_counts.get(target.attr, 0) == 1:
+                    dkey = donor_key(target)
+            if dkey is None:
                 continue
             nums: Tuple[int, ...] = ()
             names: Tuple[str, ...] = ()
@@ -180,5 +429,8 @@ class DonateRule(Rule):
                 elif kw.arg == "donate_argnames":
                     names = str_tuple_literal(kw.value) or ()
             if nums or names:
-                donors[node.targets[0].id] = DonSpec(nums, names)
-        return donors
+                if dkey in donors:
+                    donors[dkey] = DonSpec((), ())    # ambiguous: drop
+                else:
+                    donors[dkey] = DonSpec(nums, names)
+        return {k: v for k, v in donors.items() if v.nums or v.names}

@@ -19,13 +19,21 @@ Donor discovery (stand down on anything else, per the house rule):
 - an imported name resolving (one hop, through the project index) to
   such a module-level binding in its defining file — the
   "wiring module binds it, driver module loops over it" split;
-- attribute bindings (``self._jitted = ...``) and tuple-unpack plumbing
-  (``train_step, eval_step, ... = setup_training(...)``) do not resolve
-  statically and stand down.
+- (wave 4) attribute bindings — ``self._jitted = plan.jit_<entry>(...)``
+  assigned exactly once across the file registers ``self._jitted(...)``
+  call sites as donors (the serving-engine spelling);
+- (wave 4) element-wise tuple bindings — ``a, b = plan.jit_x(...),
+  plan.jit_y(...)`` pairs targets with builder calls positionally;
+- a builder result unpacked from a NON-literal right-hand side
+  (``steps = setup_training(...)``) still does not resolve statically
+  and stands down.
 
 Reuse semantics are exactly GL104's :class:`~.donate.DonationWalker`
-(same dead-name tracking, branch merge, double-pass loops), so the two
-rules can never disagree about what counts as a read-after-donate.
+(same dead-name tracking, branch merge, double-pass loops — and, since
+wave 4, the same donated-buffer tracking through tuple/list/dict
+literals, constant-key subscripts, ``*splat`` calls, and tuple-unpack
+aliasing), so the two rules can never disagree about what counts as a
+read-after-donate.
 """
 from __future__ import annotations
 
@@ -36,7 +44,9 @@ from tools.graphlint.engine import Context, Finding, LintedFile, Rule
 from tools.graphlint.project import get_index
 from tools.graphlint.rules.compile_plan_contract import (entry_donation,
                                                          plan_registry)
-from tools.graphlint.rules.donate import DonationWalker, DonSpec
+from tools.graphlint.rules.donate import (DonationWalker, DonSpec,
+                                          donor_key,
+                                          self_attr_assign_counts)
 
 
 def _builder_entry(call: ast.AST) -> Optional[str]:
@@ -92,10 +102,9 @@ class DonationFlowRule(Rule):
         """Name the plan entry whose call at ``line`` killed the buffer."""
         for node in ast.walk(f.tree):
             if (isinstance(node, ast.Call)
-                    and getattr(node, "lineno", -1) == line
-                    and isinstance(node.func, ast.Name)
-                    and node.func.id in donors):
-                d = donors[node.func.id]
+                    and getattr(node, "lineno", -1) == line):
+                dkey = donor_key(node.func)
+                d = donors.get(dkey) if dkey is not None else None
                 if isinstance(d, _Donor):
                     return (f" [plan entry {d.entry!r} declares "
                             f"DONATE == {tuple(d.nums)}{d.origin}]")
@@ -103,17 +112,38 @@ class DonationFlowRule(Rule):
 
     def _donors(self, f: LintedFile, ctx: Context) -> Dict[str, DonSpec]:
         donors: Dict[str, DonSpec] = {}
-        # local bindings: name = plan.jit_<entry>(...)
+        attr_counts = self_attr_assign_counts(f)
+        # local bindings: name = plan.jit_<entry>(...), the attribute
+        # spelling self._jitted = plan.jit_<entry>(...) (assigned-once
+        # gate), and element-wise tuple bindings a, b = plan.jit_x(...),
+        # plan.jit_y(...)
         for node in ast.walk(f.tree):
-            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1):
                 continue
-            entry = _builder_entry(node.value)
-            if entry is None:
-                continue
-            nums = entry_donation(ctx, f, entry)
-            if nums:
-                donors[node.targets[0].id] = _Donor(nums, entry, "")
+            target = node.targets[0]
+            pairs = []
+            if isinstance(target, (ast.Name, ast.Attribute)):
+                pairs = [(target, node.value)]
+            elif (isinstance(target, ast.Tuple)
+                  and isinstance(node.value, ast.Tuple)
+                  and len(target.elts) == len(node.value.elts)):
+                pairs = list(zip(target.elts, node.value.elts))
+            for tgt, value in pairs:
+                entry = _builder_entry(value)
+                if entry is None:
+                    continue
+                if isinstance(tgt, ast.Name):
+                    dkey, origin = tgt.id, ""
+                else:
+                    dkey = donor_key(tgt)
+                    if (dkey is None
+                            or attr_counts.get(tgt.attr, 0) != 1):
+                        continue     # unresolvable / rebound: stand down
+                    origin = f"; bound at line {node.lineno}"
+                nums = entry_donation(ctx, f, entry)
+                if nums:
+                    donors[dkey] = _Donor(nums, entry, origin)
         # imported bindings: from wiring import train_step
         index = get_index(ctx)
         imported = set(index.import_targets.get(f, {})) - set(donors)

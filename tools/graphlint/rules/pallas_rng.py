@@ -17,12 +17,15 @@ Detection is resolution-based (the GL109 zero-false-positive contract)
 and, since wave 3, WHOLE-PROGRAM:
 
 - a **kernel body** is any ``def`` passed (bare, through
-  ``functools.partial``, or through a simple ``name =
-  functools.partial(fn, ...)`` binding — the ops/fused_augment.py
-  spelling) as the kernel argument of a call resolving to
-  ``pallas_call`` — including a def IMPORTED from another module, which
-  is resolved through the project index (tools/graphlint/project.py)
-  and flagged at its definition site with the pallas_call site named;
+  ``functools.partial``, through a ``name = functools.partial(fn, ...)``
+  binding — chains followed transitively since wave 4, including the
+  rebound ``kernel = partial(kernel, ...)`` spelling, via
+  tools/graphlint/flow.py — or through an assigned-once ``self.<attr> =
+  ...`` class-attribute binding) as the kernel argument of a call
+  resolving to ``pallas_call`` — including a def IMPORTED from another
+  module, which is resolved through the project index
+  (tools/graphlint/project.py) and flagged at its definition site with
+  the pallas_call site named;
 - kernel scopes close over the helpers a kernel body calls — bare-name
   module-local defs, and imported defs through the index;
 - inside those scopes, any call resolving to ``jax.random.*`` is flagged;
@@ -34,6 +37,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
+from tools.graphlint import flow as flow_mod
 from tools.graphlint.astutil import FuncNode, qualname
 from tools.graphlint.engine import Context, Finding, LintedFile, Rule
 from tools.graphlint.project import (MAX_CROSS_MODULE_DEPTH, TraceSite,
@@ -53,21 +57,6 @@ def _unwrap_partial(node: ast.AST | None, f: LintedFile) -> ast.AST | None:
             and node.args):
         return node.args[0]
     return node
-
-
-def _partial_bindings(f: LintedFile) -> Dict[str, str]:
-    """Simple ``name = functools.partial(fn, ...)`` assignments anywhere
-    in the module: name -> fn (the ops/fused_augment.py spelling, where
-    the bound kernel is built a few lines above the pallas_call)."""
-    out: Dict[str, str] = {}
-    for node in ast.walk(f.tree):
-        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)):
-            continue
-        fn = _unwrap_partial(node.value, f)
-        if fn is not node.value and isinstance(fn, ast.Name):
-            out[node.targets[0].id] = fn.id
-    return out
 
 
 def _kernel_arg(node: ast.Call, f: LintedFile) -> ast.AST | None:
@@ -103,18 +92,31 @@ def _kernel_scopes(ctx: Context
                 names.setdefault(node.name, []).append(node)
         by_name[f] = names
 
+    flows = flow_mod.for_context(ctx)
     work: List[Tuple[object, ast.AST, Optional[TraceSite], int]] = []
     for f in ctx.files:
-        partials = _partial_bindings(f)
+        ff = flows[f]
+        partials = ff.partial_name_map()
         for node in ast.walk(f.tree):
             if not isinstance(node, ast.Call) or not _is_pallas_call(node,
                                                                      f):
                 continue
             arg = _kernel_arg(node, f)
+            if (isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"):
+                # self.<attr> kernel: follow the assigned-once binding
+                # (and any partial chain behind it) through flow.py
+                base, hops = ff.resolve_callable(arg, node)
+                if hops:
+                    flow_mod.bump(ctx, "attribute_bindings_resolved")
+                    arg = base
             if isinstance(arg, ast.Lambda):
                 work.append((f, arg, None, 0))
             elif isinstance(arg, ast.Name):
                 name = partials.get(arg.id, arg.id)
+                if name != arg.id:
+                    flow_mod.bump(ctx, "partial_chains_resolved")
                 local = by_name[f].get(name, ())
                 if local:
                     for k in local:

@@ -1,0 +1,151 @@
+"""GL114/GL115 — host-concurrency lints for the threaded serving/input
+surface.
+
+The repo's host side quietly grew real threads: the serving worker
+(``EmbeddingService``), the batcher, ThreadingHTTPServer handlers, the
+prefetch thread, the telemetry sink.  Python's type system says nothing
+about which attributes those threads share, and past PR reviews kept
+catching the same race shapes by hand — RunLog line interleaving,
+submit/close TOCTOU on service state.  These rules check the two shapes
+statically, on the concurrency model flow.py builds per class
+(:class:`~tools.graphlint.flow.ClassModel`).
+
+**GL114 (thread-shared-attr)** — a class that spawns
+``threading.Thread(target=self.<worker>)`` and mutates the same
+``self.<attr>`` both (a) in a method running on the worker thread and
+(b) in a public method running on the caller's thread, where the two
+sites hold NO common ``with self.<lock>:`` guard.  Lock context is
+path-sensitive: a site counts as guarded by a lock only when that lock
+is held on EVERY discovered ``self.<m>()`` path from the thread's entry
+point (path merge = intersection), so a lock taken on one branch but
+not another does not count.
+
+**GL115 (thread-shared-sink)** — writes (``.emit(...)``, ``.write(...)``,
+``.writelines(...)``) to a known non-thread-safe sink attribute — a
+``RunLog`` or an ``open()`` file bound on ``self`` — reachable from both
+a worker entry and a public method with no common lock.  Interleaved
+writers corrupt the JSONL event stream byte-wise; the single-writer
+contract must be enforced with a lock or a queue.
+
+Stand-downs (zero-false-positive contract): classes that never spawn a
+``self``-method thread are never analyzed; thread targets that are not
+``self.<method>`` (local functions, ``serve_forever`` bound methods,
+positional/``**kwargs`` target plumbing) stand down inside flow.py;
+dunder/underscore methods are not public entries (``__init__`` stores
+before the thread exists are invisible to both rules); sink attributes
+bound to anything but a recognized constructor are not sinks.
+"""
+from __future__ import annotations
+
+from typing import FrozenSet, List, Optional, Tuple
+
+from tools.graphlint import flow
+from tools.graphlint.engine import Context, Finding, Line, LintedFile, Rule
+
+# (entry method, site method, site line, locks held at the site)
+_Site = Tuple[str, str, int, FrozenSet[str]]
+
+
+def _sides(cm: "flow.ClassModel", occurrences) -> Tuple[List[_Site],
+                                                        List[_Site]]:
+    """Split event occurrences into worker-thread and public-caller
+    sides.  An occurrence lands on a side when its method is reachable
+    from that side's entry; its effective lock set is the locks always
+    held on the path (reach) plus the locks held lexically at the
+    site."""
+    worker: List[_Site] = []
+    public: List[_Site] = []
+    reaches = {e: cm.reach(e)
+               for e in cm.worker_entries() + cm.public_entries()}
+    workers = set(cm.worker_entries())
+    for mname, line, locks in occurrences:
+        for entry, held in reaches.items():
+            if mname not in held:
+                continue
+            site = (entry, mname, line, held[mname] | locks)
+            (worker if entry in workers else public).append(site)
+    return worker, public
+
+
+def _unguarded_pair(worker: List[_Site],
+                    public: List[_Site]) -> Optional[Tuple[_Site, _Site]]:
+    """First (worker site, public site) pair holding no common lock, or
+    ``None``."""
+    for w in worker:
+        for p in public:
+            if not (w[3] & p[3]):
+                return (w, p)
+    return None
+
+
+class _ThreadRuleBase(Rule):
+    def check(self, f: LintedFile, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for cm in flow.flow_of(ctx, f).classes:
+            if not cm.thread_targets:
+                continue            # no self-method thread: stand down
+            if self.id == "GL114":  # count each analyzed class once
+                flow.bump(ctx, "thread_classes_analyzed")
+            findings.extend(self._check_class(f, cm))
+        return findings
+
+    def _check_class(self, f: LintedFile,
+                     cm: "flow.ClassModel") -> List[Finding]:
+        raise NotImplementedError
+
+
+class ThreadSharedAttrRule(_ThreadRuleBase):
+    id = "GL114"
+    name = "thread-shared-attr"
+    doc = ("instance attribute mutated both on a spawned worker thread "
+           "and in a public method with no common lock guarding the "
+           "two sites")
+
+    def _check_class(self, f: LintedFile,
+                     cm: "flow.ClassModel") -> List[Finding]:
+        findings: List[Finding] = []
+        for attr in sorted(cm.attr_stores):
+            if attr in cm.lock_attrs:
+                continue
+            worker, public = _sides(cm, cm.attr_stores[attr])
+            pair = _unguarded_pair(worker, public)
+            if pair is None:
+                continue
+            w, p = pair
+            findings.append(self.finding(
+                f, Line(w[2]),
+                f"'self.{attr}' of {cm.name} is mutated on the "
+                f"{w[0]!r} worker thread (in {w[1]!r}, line {w[2]}) and "
+                f"from public method {p[0]!r} (in {p[1]!r}, line "
+                f"{p[2]}) with no common lock — thread spawned at line "
+                f"{cm.spawn_line(w[0])}; guard both sites with the "
+                "same `with self.<lock>:`"))
+        return findings
+
+
+class ThreadSharedSinkRule(_ThreadRuleBase):
+    id = "GL115"
+    name = "thread-shared-sink"
+    doc = ("non-thread-safe sink (RunLog / open()-file) written from "
+           "both a spawned worker thread and a public method with no "
+           "common lock — interleaved writes corrupt the stream")
+
+    def _check_class(self, f: LintedFile,
+                     cm: "flow.ClassModel") -> List[Finding]:
+        findings: List[Finding] = []
+        for attr in sorted(cm.sink_uses):
+            worker, public = _sides(cm, cm.sink_uses[attr])
+            pair = _unguarded_pair(worker, public)
+            if pair is None:
+                continue
+            w, p = pair
+            label = cm.sink_attrs.get(attr, "sink")
+            findings.append(self.finding(
+                f, Line(w[2]),
+                f"'self.{attr}' ({label}) of {cm.name} is written from "
+                f"the {w[0]!r} worker thread (in {w[1]!r}, line {w[2]}) "
+                f"and from public method {p[0]!r} (in {p[1]!r}, line "
+                f"{p[2]}) with no common lock — {label} writes are not "
+                "thread-safe; serialize them with one lock or a "
+                "single-writer queue"))
+        return findings
